@@ -1,0 +1,31 @@
+"""jepsen_tpu — a TPU-native distributed-systems correctness-testing framework.
+
+Capability-equivalent rebuild of Jepsen (reference: /root/reference, Clojure).
+The control plane (SSH cluster automation, fault injection, concurrent op
+scheduling) is host-side Python + native C++ tools; the analysis plane (history
+checking: linearizability, transactional and structural invariants) is a
+batched tensor search running under JAX/XLA on TPU.
+
+Architecture map (reference file:line citations are to /root/reference):
+
+- history/   op + history model, columnar int32 tensor view
+             (ref: knossos op shape; jepsen.txn micro-ops, txn/README.md:7-70)
+- models/    consistency-model state machines + dense transition-table
+             compilation (ref: knossos models, jepsen/src/jepsen/checker.clj:17-23)
+- ops/       pure JAX kernels: frontier expansion, sort-dedup, segment
+             reductions (the TPU-resident hot loops)
+- checkers/  Checker protocol + checker suite
+             (ref: jepsen/src/jepsen/checker.clj)
+- generators/ pure generator protocol + combinators
+             (ref: jepsen/src/jepsen/generator/pure.clj)
+- runtime/   test orchestration: run(), workers, crash cycling
+             (ref: jepsen/src/jepsen/core.clj)
+- control/   remote execution over SSH, daemon helpers
+             (ref: jepsen/src/jepsen/control.clj)
+- nemesis/   fault injection (ref: jepsen/src/jepsen/nemesis.clj)
+- parallel/  device-mesh sharding of the analysis plane (pjit/shard_map)
+- workloads/ reusable generator+checker bundles (ref: jepsen/src/jepsen/tests/)
+- suites/    per-database test suites (ref: etcd/, tidb/, ...)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,37 @@
+"""History model: operations, histories, and their columnar tensor view."""
+
+from jepsen_tpu.history.ops import (
+    Op,
+    INVOKE,
+    OK,
+    FAIL,
+    INFO,
+    TYPES,
+    invoke_op,
+    ok_op,
+    fail_op,
+    info_op,
+)
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.columnar import (
+    ColumnarHistory,
+    Encoder,
+    TYPE_CODES,
+)
+
+__all__ = [
+    "Op",
+    "INVOKE",
+    "OK",
+    "FAIL",
+    "INFO",
+    "TYPES",
+    "invoke_op",
+    "ok_op",
+    "fail_op",
+    "info_op",
+    "History",
+    "ColumnarHistory",
+    "Encoder",
+    "TYPE_CODES",
+]

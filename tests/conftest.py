@@ -1,0 +1,15 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU mesh BEFORE jax is imported anywhere:
+multi-chip sharding paths (pjit/shard_map over a Mesh) are exercised on CPU
+devices in CI; real-TPU execution is covered by bench.py / the driver.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,41 @@
+import numpy as np
+
+from jepsen_tpu import txn
+
+
+def test_accessors():
+    m = txn.w("x", 3)
+    assert txn.op_type(m) == "w"
+    assert txn.key(m) == "x"
+    assert txn.value(m) == 3
+    assert txn.is_write(m) and not txn.is_read(m)
+
+
+def test_ext_reads_writes():
+    t = [txn.r("x"), txn.w("x", 1), txn.r("x", 1), txn.r("y"), txn.w("y", 2)]
+    assert txn.ext_reads(t) == {"x": None, "y": None}
+    assert txn.ext_writes(t) == {"x": 1, "y": 2}
+
+
+def test_apply_txn_fills_reads():
+    state, done = txn.apply_txn({}, [txn.w("x", 5), txn.r("x")])
+    assert state == {"x": 5}
+    assert done[1] == ("r", "x", 5)
+
+
+def test_encode_txns_padding_and_codes():
+    t1 = [txn.w("x", 1), txn.r("y")]
+    t2 = [txn.r("x", 1)]
+    arr, kc, vc = txn.encode_txns([t1, t2])
+    assert arr.shape == (2, 2, 3)
+    assert arr[0, 0].tolist() == [1, kc["x"], vc[1]]
+    assert arr[0, 1].tolist() == [0, kc["y"], txn.NIL]
+    assert arr[1, 1].tolist() == [-1, -1, -1]  # padding
+
+
+def test_gen_txn_deterministic_with_seed():
+    import random
+
+    a = txn.gen_txn(["x", "y"], rng=random.Random(7))
+    b = txn.gen_txn(["x", "y"], rng=random.Random(7))
+    assert a == b

@@ -1,0 +1,77 @@
+import time
+
+import pytest
+
+from jepsen_tpu.utils import (
+    JepsenTimeout,
+    bounded_pmap,
+    fcatch,
+    majority,
+    minority,
+    nemesis_intervals,
+    real_pmap,
+    timeout,
+    with_retry,
+)
+from jepsen_tpu.history import History, Op
+
+
+def test_majority_minority():
+    assert majority(5) == 3
+    assert majority(4) == 3
+    assert majority(1) == 1
+    assert minority(5) == 2
+    assert minority(4) == 1
+
+
+def test_real_pmap_parallel_and_errors():
+    assert sorted(real_pmap(lambda x: x * 2, [1, 2, 3])) == [2, 4, 6]
+    with pytest.raises(ValueError):
+        real_pmap(lambda x: (_ for _ in ()).throw(ValueError("boom")), [1])
+
+
+def test_bounded_pmap():
+    assert bounded_pmap(lambda x: x + 1, range(10), bound=3) == list(
+        range(1, 11)
+    )
+
+
+def test_timeout_returns_default():
+    assert timeout(0.05, lambda: time.sleep(1), default="late") == "late"
+    assert timeout(1.0, lambda: 42) == 42
+    with pytest.raises(JepsenTimeout):
+        timeout(0.05, lambda: time.sleep(1))
+
+
+def test_with_retry():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert with_retry(flaky, retries=5, backoff=0) == "ok"
+    assert len(calls) == 3
+
+
+def test_fcatch():
+    e = fcatch(lambda: (_ for _ in ()).throw(RuntimeError("x")))()
+    assert isinstance(e, RuntimeError)
+
+
+def test_nemesis_intervals():
+    h = History(
+        [
+            Op(type="invoke", f="start", process="nemesis", time=1),
+            Op(type="info", f="start", process="nemesis", time=2),
+            Op(type="invoke", f="stop", process="nemesis", time=5),
+            Op(type="info", f="stop", process="nemesis", time=6),
+            Op(type="invoke", f="start", process="nemesis", time=8),
+        ]
+    )
+    ivals = nemesis_intervals(h)
+    assert len(ivals) == 2
+    assert ivals[0][0].time == 1 and ivals[0][1].time == 6
+    assert ivals[1][1] is None
