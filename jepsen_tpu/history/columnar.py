@@ -37,16 +37,36 @@ TYPE_NAMES = {v: k for k, v in TYPE_CODES.items()}
 NIL = -1  # encoded None / unknown
 
 
-def _hashable(v):
+def intern_key(v):
     """Canonicalize a payload to a hashable interning key: set-workload reads
-    are lists, txn payloads can be dicts."""
+    are lists, txn payloads can be dicts. Scalars key on (kind, value) so
+    True/1 and 0/False intern to distinct codes — int vs float also stay
+    distinct, matching the reference's Clojure equality where (= 1 1.0) is
+    false — while numpy scalars normalize to their Python kind."""
+    if isinstance(v, bool) or isinstance(v, np.bool_):
+        return ("bool", bool(v))
+    if isinstance(v, (int, np.integer)):
+        return ("int", int(v))
+    if isinstance(v, (float, np.floating)):
+        return ("float", float(v))
     if isinstance(v, (list, tuple)):
-        return tuple(_hashable(x) for x in v)
-    if isinstance(v, set):
-        return frozenset(_hashable(x) for x in v)
+        return ("seq", tuple(intern_key(x) for x in v))
+    if isinstance(v, (set, frozenset)):
+        return ("set", frozenset(intern_key(x) for x in v))
     if isinstance(v, dict):
-        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
-    return v
+        return (
+            "map",
+            tuple(
+                sorted(
+                    ((intern_key(k), intern_key(x)) for k, x in v.items()),
+                    key=repr,
+                )
+            ),
+        )
+    return (type(v).__name__, v)
+
+
+_hashable = intern_key  # backward-compat alias
 
 
 class Encoder:
@@ -92,13 +112,23 @@ class Encoder:
     def n_values(self) -> int:
         return len(self._value_rev)
 
+    #: fs whose 2-element payload is semantically an (old, new) pair and
+    #: spreads across (v0, v1). Everything else — including a 2-element
+    #: set-workload read — interns as a single value code.
+    PAIR_FS = frozenset({"cas", "compare-and-set", "transfer"})
+
     def encode_payload(self, op: Op) -> tuple:
-        """(v0, v1) for an op's value. Pairs (e.g. cas [old new]) spread
-        across both slots; scalars use v0."""
+        """(v0, v1) for an op's value. Only pair-semantics fs (PAIR_FS, e.g.
+        cas [old new]) spread across both slots; any other payload — scalar
+        or collection — interns whole into v0, so decode is unambiguous."""
         v = op.value
         if v is None:
             return (NIL, NIL)
-        if isinstance(v, (list, tuple)) and len(v) == 2:
+        if (
+            op.f in self.PAIR_FS
+            and isinstance(v, (list, tuple))
+            and len(v) == 2
+        ):
             return (self.value_code(v[0]), self.value_code(v[1]))
         return (self.value_code(v), NIL)
 

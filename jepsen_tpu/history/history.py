@@ -27,10 +27,25 @@ class History:
         if not indexed:
             self._assign_indices()
         self._pairs: Optional[dict] = None
+        self._pos: Optional[dict] = None
 
     def _assign_indices(self) -> None:
-        for i, o in enumerate(self.ops):
-            o.index = i
+        # Never mutate caller-owned Ops: two Histories built from one op list
+        # must not clobber each other's indices.
+        self.ops = [
+            o if o.index == i else o.with_(index=i)
+            for i, o in enumerate(self.ops)
+        ]
+
+    def _position(self, index: int) -> Optional[int]:
+        """Position in self.ops of the op with the given history index.
+
+        On filtered/sliced histories list position != op.index, so every
+        pair-following query resolves through this map.
+        """
+        if self._pos is None:
+            self._pos = {o.index: i for i, o in enumerate(self.ops)}
+        return self._pos.get(index)
 
     # -- sequence protocol --------------------------------------------------
     def __len__(self) -> int:
@@ -77,26 +92,42 @@ class History:
 
     def completion(self, invocation: Op) -> Optional[Op]:
         j = self.pairs().get(invocation.index)
-        return None if j is None else self.ops[j]
+        if j is None:
+            return None
+        p = self._position(j)
+        return None if p is None else self.ops[p]
 
     def invocation(self, completion: Op) -> Optional[Op]:
         j = self.pairs().get(completion.index)
-        return None if j is None else self.ops[j]
+        if j is None:
+            return None
+        p = self._position(j)
+        return None if p is None else self.ops[p]
 
     def complete(self) -> "History":
-        """Copy :ok completion values back onto invocations, and mark
-        invocations whose completion is :info (or missing) as crashed by
-        rewriting their completion type view. Mirrors knossos
-        history/complete (used at checker.clj:699).
+        """Fill in invocations from their completions, mirroring knossos
+        history/complete (used at checker.clj:699):
+
+        - :ok completion — its value is authoritative; copy it back onto the
+          invocation.
+        - :fail completion — the op definitely did not happen; mark the
+          invocation with fails=True.
+        - :info completion or none — the process crashed; the op stays
+          concurrent with everything after it; mark crashed=True.
         """
         pairs = self.pairs()
         new_ops = []
         for o in self.ops:
             if o.is_invoke:
                 j = pairs.get(o.index)
-                comp = self.ops[j] if j is not None else None
+                p = self._position(j) if j is not None else None
+                comp = self.ops[p] if p is not None else None
                 if comp is not None and comp.is_ok:
                     o = o.with_(value=comp.value)
+                elif comp is not None and comp.is_fail:
+                    o = o.with_(fails=True)
+                else:
+                    o = o.with_(crashed=True)
             new_ops.append(o)
         return History(new_ops, indexed=True)
 
@@ -145,8 +176,9 @@ class History:
         for o in self.ops:
             if o.is_invoke and o.is_client_op:
                 j = pairs.get(o.index)
-                if j is not None:
-                    comp = self.ops[j]
+                p = self._position(j) if j is not None else None
+                if p is not None:
+                    comp = self.ops[p]
                     out.append((o, comp, comp.time - o.time))
         return out
 

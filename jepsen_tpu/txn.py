@@ -143,7 +143,11 @@ def encode_txns(
     key_codes = dict(key_codes or {})
     value_codes = dict(value_codes or {})
 
+    from jepsen_tpu.history.columnar import intern_key
+
     def kc(k):
+        # Canonical (kind, value) keys so True/1 and 0/False stay distinct.
+        k = intern_key(k)
         if k not in key_codes:
             key_codes[k] = len(key_codes)
         return key_codes[k]
@@ -151,6 +155,7 @@ def encode_txns(
     def vc(v):
         if v is None:
             return NIL
+        v = intern_key(v)
         if v not in value_codes:
             value_codes[v] = len(value_codes)
         return value_codes[v]

@@ -162,19 +162,25 @@ def relative_time_nanos(reset: bool = False) -> int:
 
 
 def nemesis_intervals(history, start_fs=("start",), stop_fs=("stop",)) -> list:
-    """Pair nemesis start/stop ops into [start_op, stop_op|None] intervals.
-    Ref: util.clj:635-658."""
-    starts: list = []
+    """Pair nemesis start/stop ops into [start_op, stop_op] intervals.
+
+    FIFO pairing over every nemesis op whose :f matches, regardless of type —
+    a nemesis usually goes :start :start :stop :stop (invoke/complete), so the
+    first start pairs with the first stop and the second with the second.
+    Stops with no outstanding start yield [None, stop]; starts with no stop
+    yield [start, None]. Ref: util.clj:635-658.
+    """
+    from collections import deque
+
+    starts: deque = deque()
     out = []
     for op in history:
         if getattr(op, "process", None) != "nemesis":
             continue
-        if op.f in start_fs and op.type in ("info", "ok", "invoke"):
-            if op.type == "invoke":
-                starts.append(op)
-        elif op.f in stop_fs and op.type in ("info", "ok"):
-            while starts:
-                out.append([starts.pop(), op])
+        if op.f in start_fs:
+            starts.append(op)
+        elif op.f in stop_fs:
+            out.append([starts.popleft() if starts else None, op])
     out.extend([[s, None] for s in starts])
     return out
 

@@ -28,8 +28,9 @@ def test_encode_txns_padding_and_codes():
     t2 = [txn.r("x", 1)]
     arr, kc, vc = txn.encode_txns([t1, t2])
     assert arr.shape == (2, 2, 3)
-    assert arr[0, 0].tolist() == [1, kc["x"], vc[1]]
-    assert arr[0, 1].tolist() == [0, kc["y"], txn.NIL]
+    # code dicts key on (type_name, value) so True/1, 0/False stay distinct
+    assert arr[0, 0].tolist() == [1, kc[("str", "x")], vc[("int", 1)]]
+    assert arr[0, 1].tolist() == [0, kc[("str", "y")], txn.NIL]
     assert arr[1, 1].tolist() == [-1, -1, -1]  # padding
 
 

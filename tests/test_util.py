@@ -72,6 +72,9 @@ def test_nemesis_intervals():
         ]
     )
     ivals = nemesis_intervals(h)
-    assert len(ivals) == 2
-    assert ivals[0][0].time == 1 and ivals[0][1].time == 6
-    assert ivals[1][1] is None
+    # FIFO pairing (util.clj:635-658): :start :start :stop :stop pairs
+    # first-with-first and second-with-second; trailing start is open.
+    assert len(ivals) == 3
+    assert ivals[0][0].time == 1 and ivals[0][1].time == 5
+    assert ivals[1][0].time == 2 and ivals[1][1].time == 6
+    assert ivals[2][0].time == 8 and ivals[2][1] is None
