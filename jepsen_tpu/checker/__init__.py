@@ -1,0 +1,47 @@
+"""Checkers: the TPU-resident analysis plane.
+
+Layer L6 of the blueprint (SURVEY.md §1): pure functions from histories
+to verdict maps. The linearizability engine (linearizable.py + wgl_jax.py)
+is the knossos replacement — the framework's north star.
+"""
+
+from jepsen_tpu.checker.core import (
+    Checker,
+    ComposeChecker,
+    ConcurrencyLimitChecker,
+    FnChecker,
+    NoopChecker,
+    UNKNOWN,
+    check_safe,
+    compose,
+    concurrency_limit,
+    merge_valid,
+)
+from jepsen_tpu.checker.linearizable import (
+    LinearizableChecker,
+    check_events_bucketed,
+    linearizable,
+)
+from jepsen_tpu.checker.events import EventStream, history_to_events
+from jepsen_tpu.checker.models import MODELS, Model, model
+
+__all__ = [
+    "Checker",
+    "ComposeChecker",
+    "ConcurrencyLimitChecker",
+    "FnChecker",
+    "NoopChecker",
+    "UNKNOWN",
+    "check_safe",
+    "compose",
+    "concurrency_limit",
+    "merge_valid",
+    "LinearizableChecker",
+    "check_events_bucketed",
+    "linearizable",
+    "EventStream",
+    "history_to_events",
+    "MODELS",
+    "Model",
+    "model",
+]

@@ -1,0 +1,222 @@
+"""Host-side history -> event-stream preprocessing for the WGL engine.
+
+The frontier search (oracle and TPU kernel alike) consumes a flat event
+stream, not op records. Each event is five int32s:
+
+  kind   0=INVOKE 1=RETURN 2=NOP (padding)
+  slot   window slot in [0, W) occupied by the op
+  f      model f-code (models.F_READ/WRITE/CAS)
+  a, b   interned value codes (NIL=-1 encodes None)
+
+Construction rules (semantics per knossos / the reference runtime,
+jepsen/src/jepsen/core.clj:199-232,338-355):
+
+- The history is ``complete()``d first: :ok completion values are copied
+  onto invocations (authoritative results), :fail invocations are marked
+  ``fails`` and dropped (the op never happened), :info invocations are
+  marked ``crashed``.
+- A kept invocation emits INVOKE at its history position; its :ok
+  completion emits RETURN. :info completions emit nothing — a crashed op
+  may take effect at any moment after its invocation, indefinitely, so
+  it stays open (its slot is never freed).
+- Crashed *reads* are dropped entirely: an unconstrained read with no
+  observable result neither constrains nor changes the register.
+- Slots are assigned from a free list at INVOKE and recycled at RETURN.
+  The maximum concurrently-open count is the required window W; masks
+  are int32 bitsets so W must be <= 31 (the reference's own guidance
+  caps ~20 processes per key — linearizable_register.clj:44-53).
+"""
+
+from __future__ import annotations
+
+import heapq
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from jepsen_tpu.checker.models import F_CAS, F_READ, Model, model as get_model
+from jepsen_tpu.history.history import History
+
+EV_INVOKE, EV_RETURN, EV_NOP = 0, 1, 2
+
+NIL = -1
+
+MAX_WINDOW = 31
+
+
+class WindowOverflow(Exception):
+    """More than MAX_WINDOW ops were concurrently open."""
+
+
+@dataclass
+class EventStream:
+    """Dense event arrays plus the decoding context."""
+
+    kind: np.ndarray  # [n] int32
+    slot: np.ndarray  # [n] int32
+    f: np.ndarray  # [n] int32
+    a: np.ndarray  # [n] int32
+    b: np.ndarray  # [n] int32
+    window: int  # max slots concurrently open
+    init_state: int  # value code of the register's initial value
+    n_ops: int  # kept invocations
+    value_codes: Dict[Any, Any] = field(default_factory=dict)
+    #: op index (in the source history) per event, for error reporting
+    op_index: Optional[np.ndarray] = None
+
+    def __len__(self) -> int:
+        return int(self.kind.shape[0])
+
+    def as_tuple(self):
+        return (self.kind, self.slot, self.f, self.a, self.b)
+
+    def padded(self, n: int) -> "EventStream":
+        """Pad with NOP events to length n (shape-bucketing for jit)."""
+        cur = len(self)
+        if n < cur:
+            raise ValueError(f"cannot pad {cur} events down to {n}")
+        if n == cur:
+            return self
+        pad = n - cur
+
+        def ext(arr, fill):
+            return np.concatenate([arr, np.full(pad, fill, np.int32)])
+
+        return EventStream(
+            kind=ext(self.kind, EV_NOP),
+            slot=ext(self.slot, 0),
+            f=ext(self.f, 0),
+            a=ext(self.a, 0),
+            b=ext(self.b, 0),
+            window=self.window,
+            init_state=self.init_state,
+            n_ops=self.n_ops,
+            value_codes=self.value_codes,
+            op_index=ext(self.op_index, -1) if self.op_index is not None else None,
+        )
+
+
+def history_to_events(
+    history: History,
+    model: Any = "cas-register",
+    init_value: Any = None,
+    max_window: int = MAX_WINDOW,
+) -> EventStream:
+    """Encode a record history into an EventStream for the given model.
+
+    Raises WindowOverflow if concurrency (open ops incl. crashed ones)
+    exceeds max_window.
+    """
+    m: Model = get_model(model)
+    h = history.complete()
+
+    # Value interning local to this check: None -> NIL, else dense codes.
+    # Keyed through intern_key so True/1 and 0/False stay distinct (same
+    # typed-equality discipline as the columnar encoder).
+    from jepsen_tpu.history.columnar import intern_key
+
+    codes: Dict[Any, int] = {}
+
+    def code(v) -> int:
+        if v is None:
+            return NIL
+        k = intern_key(v)
+        c = codes.get(k)
+        if c is None:
+            c = len(codes)
+            codes[k] = c
+        return c
+
+    init_state = code(init_value)
+
+    kind: List[int] = []
+    slot: List[int] = []
+    fcol: List[int] = []
+    acol: List[int] = []
+    bcol: List[int] = []
+    op_index: List[int] = []
+
+    # Min-heap of recycled slots plus a high-water counter: always reuse
+    # the smallest index so slots stay dense in [0, max-concurrency) —
+    # the kernel's W (mask width) must cover max slot index + 1, not
+    # just the concurrency count.
+    free: List[int] = []
+    next_fresh = 0
+    open_slot: Dict[int, int] = {}  # invocation index -> slot
+    window = 0
+    n_ops = 0
+
+    pairs = h.pairs()
+
+    def encode_fab(op) -> Optional[tuple]:
+        fc = m.f_code(op.f)
+        if fc < 0:
+            return None
+        v = op.value
+        # Only cas payloads spread [old, new] across (a, b); any other
+        # value — including a 2-element list written to the register —
+        # interns whole (same gating as columnar.Encoder.encode_payload).
+        if fc == F_CAS and isinstance(v, (list, tuple)) and len(v) == 2:
+            return (fc, code(v[0]), code(v[1]))
+        return (fc, code(v), 0)
+
+    for op in h.ops:
+        if not op.is_client_op:
+            continue
+        if op.is_invoke:
+            if op.get("fails"):
+                continue  # :fail — the op never happened
+            fab = encode_fab(op)
+            if fab is None:
+                continue  # outside the model
+            fc, a, b = fab
+            if op.get("crashed") and fc == F_READ:
+                continue  # unconstrained crashed read: no effect
+            if free:
+                s = heapq.heappop(free)
+            elif next_fresh < max_window:
+                s = next_fresh
+                next_fresh += 1
+            else:
+                raise WindowOverflow(
+                    f"more than {max_window} concurrently-open ops "
+                    f"at history index {op.index}"
+                )
+            open_slot[op.index] = s
+            window = max(window, s + 1)
+            n_ops += 1
+            kind.append(EV_INVOKE)
+            slot.append(s)
+            fcol.append(fc)
+            acol.append(a)
+            bcol.append(b)
+            op_index.append(op.index)
+        elif op.is_ok:
+            inv = pairs.get(op.index)
+            if inv is None or inv not in open_slot:
+                continue
+            s = open_slot.pop(inv)
+            heapq.heappush(free, s)
+            kind.append(EV_RETURN)
+            slot.append(s)
+            fcol.append(0)
+            acol.append(0)
+            bcol.append(0)
+            op_index.append(op.index)
+        # :fail completions: invocation already dropped via `fails` mark.
+        # :info completions: op stays open forever; emit nothing.
+
+    return EventStream(
+        kind=np.asarray(kind, np.int32),
+        slot=np.asarray(slot, np.int32),
+        f=np.asarray(fcol, np.int32),
+        a=np.asarray(acol, np.int32),
+        b=np.asarray(bcol, np.int32),
+        window=window,
+        init_state=init_state,
+        n_ops=n_ops,
+        value_codes=dict(codes),
+        op_index=np.asarray(op_index, np.int32),
+    )

@@ -1,0 +1,131 @@
+"""Sequential-object models for linearizability checking.
+
+The reference delegates model semantics to knossos (cas-register,
+register, mutex, unordered-queue — jepsen/project.clj:13; constructors
+used in jepsen/test/jepsen/checker_test.clj:5-7). Here a model is a pure
+transition function over *dense int32 codes*, in two synchronized
+implementations:
+
+- ``step_py(state, f, a, b) -> (ok, state')`` — scalar Python, consumed
+  by the CPU oracle.
+- ``step_jax(state, f, a, b) -> (ok, state')`` — broadcastable
+  jax.numpy, consumed by the batched TPU frontier kernel. ``state`` may
+  be [K,1] while f/a/b are [1,W]; the result broadcasts to [K,W].
+
+Op encoding shared by both: an op is (f, a, b) int32s, where f is a
+model-local code and a/b are interned value codes (NIL=-1 encodes None).
+
+  cas-register:  read v   -> (F_READ,  code(v), 0)    ok iff state==a
+                 write v  -> (F_WRITE, code(v), 0)    always ok, state'=a
+                 cas[u,v] -> (F_CAS,   code(u), code(v)) ok iff state==u,
+                                                         state'=b
+
+A cas that linearizes is a *successful* cas; an unsuccessful cas has no
+effect, which is identical to never linearizing it — so the model only
+needs the success transition (matching knossos's cas-register step).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax.numpy as jnp
+
+NIL = -1
+
+F_READ, F_WRITE, F_CAS = 0, 1, 2
+
+#: op.f spellings accepted per model f-code (suites use :read/:write/:cas,
+#: e.g. /root/reference/etcd/src/jepsen/etcd.clj:145-147).
+F_NAMES: Dict[Any, int] = {
+    "read": F_READ,
+    "r": F_READ,
+    ":read": F_READ,
+    "write": F_WRITE,
+    "w": F_WRITE,
+    ":write": F_WRITE,
+    "cas": F_CAS,
+    "compare-and-set": F_CAS,
+    ":cas": F_CAS,
+}
+
+
+def cas_register_step_py(state: int, f: int, a: int, b: int) -> Tuple[bool, int]:
+    if f == F_READ:
+        return state == a, state
+    if f == F_WRITE:
+        return True, a
+    if f == F_CAS:
+        return state == a, b
+    raise ValueError(f"unknown f code {f}")
+
+
+def cas_register_step_jax(state, f, a, b):
+    is_read = f == F_READ
+    is_write = f == F_WRITE
+    is_cas = f == F_CAS
+    ok = jnp.where(is_write, True, (state == a) & (is_read | is_cas))
+    state2 = jnp.where(is_write, a, jnp.where(is_cas, b, state))
+    return ok, state2
+
+
+def register_step_py(state: int, f: int, a: int, b: int) -> Tuple[bool, int]:
+    """Plain read/write register (knossos model/register): cas is invalid."""
+    if f == F_READ:
+        return state == a, state
+    if f == F_WRITE:
+        return True, a
+    return False, state
+
+
+def register_step_jax(state, f, a, b):
+    is_read = f == F_READ
+    is_write = f == F_WRITE
+    ok = is_write | (is_read & (state == a))
+    state2 = jnp.where(is_write, a, state)
+    return ok, state2
+
+
+class Model:
+    """A named model: python + jax step functions over int32 codes, plus
+    the op.f -> f-code mapping used when encoding histories."""
+
+    def __init__(
+        self,
+        name: str,
+        step_py: Callable,
+        step_jax: Callable,
+        f_names: Dict[Any, int],
+    ):
+        self.name = name
+        self.step_py = step_py
+        self.step_jax = step_jax
+        self.f_names = f_names
+
+    def f_code(self, f) -> int:
+        """Model f-code for an op.f, or -1 if the op is outside the model."""
+        return self.f_names.get(f, -1)
+
+    def __repr__(self) -> str:
+        return f"Model({self.name})"
+
+
+MODELS: Dict[str, Model] = {
+    "cas-register": Model(
+        "cas-register", cas_register_step_py, cas_register_step_jax, F_NAMES
+    ),
+    "register": Model(
+        "register", register_step_py, register_step_jax, F_NAMES
+    ),
+}
+
+
+def model(name_or_model) -> Model:
+    if isinstance(name_or_model, Model):
+        return name_or_model
+    m = MODELS.get(name_or_model)
+    if m is None:
+        raise KeyError(
+            f"unknown model {name_or_model!r}; have {sorted(MODELS)}"
+        )
+    return m

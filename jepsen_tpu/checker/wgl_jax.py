@@ -1,0 +1,189 @@
+"""Batched WGL frontier search under jax.jit — the TPU linearizability
+kernel. This is the accelerator-resident replacement for the knossos
+search the reference shells out to (jepsen/src/jepsen/checker.clj:127-158,
+project.clj:13); design per SURVEY.md §7.2.
+
+Formulation (just-in-time linearization, tensorized):
+
+- A *configuration* is (state, mask): the register's interned value code
+  and an int32 bitset of which currently-open ops have linearized.
+- The frontier is a fixed-size padded buffer of K configurations with a
+  validity mask — no hash tables; set semantics come from lexicographic
+  sort + neighbor-compare dedup + stable compaction (all MXU/VPU-friendly
+  primitives).
+- The event stream is consumed by one `lax.scan`. INVOKE events only
+  update the open-slot tables. RETURN events run the closure (a
+  `lax.while_loop` of vectorized expand→dedup rounds: each round tries to
+  linearize every open op against every configuration at once, a [K, W]
+  broadcast of the model step), then filter to configurations with the
+  returning op linearized, clear its bit, and recycle the slot.
+- Closure convergence: the within-event frontier grows monotonically
+  (originals are always kept), so `count == prev_count` is a fixpoint;
+  the loop is also bounded by W+1 rounds.
+
+Soundness under overflow: a surviving configuration is a *witness* — it
+descends from a chain of legal linearizations that passed every RETURN
+filter — so alive=True is trustworthy even if the frontier buffer
+overflowed (drops lose witnesses, never create them). alive=False with
+overflow is "unknown": the driver escalates K (shape-bucketed recompile)
+and finally falls back to the unbounded CPU oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from jepsen_tpu.checker.events import EV_INVOKE, EV_NOP, EV_RETURN, EventStream
+from jepsen_tpu.checker.models import model as get_model
+
+SENTINEL = jnp.int32(2**31 - 1)
+
+
+def _dedup_compact(s, m, v):
+    """Deduplicate (s, m) rows and compact valid rows to the front.
+
+    Returns (s', m', v') of the same length: valid rows are the unique
+    configurations, sorted, followed by sentinel padding.
+    """
+    s = jnp.where(v, s, SENTINEL)
+    m = jnp.where(v, m, SENTINEL)
+    s, m = lax.sort((s, m), num_keys=2)
+    dup = (s == jnp.roll(s, 1)) & (m == jnp.roll(m, 1))
+    dup = dup.at[0].set(False)
+    valid = (s != SENTINEL) & ~dup
+    key = (~valid).astype(jnp.int32)
+    key, s, m = lax.sort((key, s, m), num_keys=1, is_stable=True)
+    return s, m, key == 0
+
+
+def _make_step(model_name: str, K: int, W: int):
+    """Build the scan step function for static (model, K, W)."""
+    step_jax = get_model(model_name).step_jax
+    slot_bits = jnp.left_shift(jnp.int32(1), jnp.arange(W, dtype=jnp.int32))
+
+    def closure_round(fs, fm, fv, occ, sf, sa, sb):
+        # Expand: linearize every open, unlinearized op against every
+        # configuration — [K, W] broadcast of the model step.
+        lin = (fm[:, None] & slot_bits[None, :]) != 0
+        elig = fv[:, None] & occ[None, :] & ~lin
+        ok, s2 = step_jax(fs[:, None], sf[None, :], sa[None, :], sb[None, :])
+        cand_v = (elig & ok).reshape(-1)
+        cand_s = s2.reshape(-1)
+        cand_m = (fm[:, None] | slot_bits[None, :]).reshape(-1)
+        all_s = jnp.concatenate([fs, cand_s])
+        all_m = jnp.concatenate([fm, cand_m])
+        all_v = jnp.concatenate([fv, cand_v])
+        all_s, all_m, all_v = _dedup_compact(all_s, all_m, all_v)
+        overflow = jnp.any(all_v[K:])
+        return all_s[:K], all_m[:K], all_v[:K], overflow
+
+    def closure(fs, fm, fv, occ, sf, sa, sb):
+        def cond(st):
+            _, _, _, cnt, prev, _, i = st
+            return (cnt > prev) & (i <= W)
+
+        def body(st):
+            fs, fm, fv, cnt, _, ovf, i = st
+            fs, fm, fv, ovf2 = closure_round(fs, fm, fv, occ, sf, sa, sb)
+            return (fs, fm, fv, fv.sum(), cnt, ovf | ovf2, i + 1)
+
+        init = (fs, fm, fv, fv.sum(), jnp.int32(-1), jnp.bool_(False), 0)
+        fs, fm, fv, _, _, ovf, _ = lax.while_loop(cond, body, init)
+        return fs, fm, fv, ovf
+
+    def invoke_branch(carry, ev):
+        fs, fm, fv, occ, sf, sa, sb, alive, ovf = carry
+        _, slot, f, a, b = ev
+        occ = occ.at[slot].set(True)
+        sf = sf.at[slot].set(f)
+        sa = sa.at[slot].set(a)
+        sb = sb.at[slot].set(b)
+        return (fs, fm, fv, occ, sf, sa, sb, alive, ovf)
+
+    def return_branch(carry, ev):
+        fs, fm, fv, occ, sf, sa, sb, alive, ovf = carry
+        _, slot, _, _, _ = ev
+
+        def live(_):
+            cfs, cfm, cfv, covf = closure(fs, fm, fv, occ, sf, sa, sb)
+            bit = jnp.left_shift(jnp.int32(1), slot)
+            cfv = cfv & ((cfm & bit) != 0)
+            cfm = cfm & ~bit
+            # Clearing the bit can merge configs; re-dedup so duplicate
+            # rows don't eat frontier capacity.
+            cfs2, cfm2, cfv2 = _dedup_compact(cfs, cfm, cfv)
+            return cfs2, cfm2, cfv2, covf
+
+        def dead(_):
+            return fs, fm, fv, jnp.bool_(False)
+
+        fs, fm, fv, covf = lax.cond(alive, live, dead, None)
+        occ = occ.at[slot].set(False)
+        alive = alive & jnp.any(fv)
+        return (fs, fm, fv, occ, sf, sa, sb, alive, ovf | covf)
+
+    def nop_branch(carry, ev):
+        return carry
+
+    def step(carry, ev):
+        kind = ev[0]
+        carry = lax.switch(
+            kind,
+            [invoke_branch, return_branch, nop_branch],
+            carry,
+            ev,
+        )
+        return carry, None
+
+    return step
+
+
+@functools.partial(jax.jit, static_argnames=("model_name", "K", "W"))
+def _wgl_scan(kind, slot, f, a, b, init_state, model_name: str, K: int, W: int):
+    step = _make_step(model_name, K, W)
+    fs = jnp.full((K,), SENTINEL, jnp.int32).at[0].set(init_state)
+    fm = jnp.zeros((K,), jnp.int32)
+    fv = jnp.zeros((K,), bool).at[0].set(True)
+    occ = jnp.zeros((W,), bool)
+    sf = jnp.zeros((W,), jnp.int32)
+    sa = jnp.zeros((W,), jnp.int32)
+    sb = jnp.zeros((W,), jnp.int32)
+    carry = (fs, fm, fv, occ, sf, sa, sb, jnp.bool_(True), jnp.bool_(False))
+    events = jnp.stack([kind, slot, f, a, b], axis=1)
+    carry, _ = lax.scan(step, carry, events)
+    *_, alive, overflow = carry
+    return alive, overflow
+
+
+def check_events_jax(
+    events: EventStream,
+    model: str = "cas-register",
+    K: int = 64,
+    W: int | None = None,
+) -> Tuple[bool, bool]:
+    """Run the kernel over an event stream. Returns (alive, overflow).
+
+    alive=True is always trustworthy; alive=False is trustworthy only
+    when overflow=False (see module docstring).
+    """
+    W = W if W is not None else max(events.window, 1)
+    if events.window > W:
+        raise ValueError(f"window {events.window} exceeds kernel W={W}")
+    alive, overflow = _wgl_scan(
+        jnp.asarray(events.kind),
+        jnp.asarray(events.slot),
+        jnp.asarray(events.f),
+        jnp.asarray(events.a),
+        jnp.asarray(events.b),
+        jnp.int32(events.init_state),
+        model_name=model if isinstance(model, str) else model.name,
+        K=K,
+        W=W,
+    )
+    return bool(alive), bool(overflow)

@@ -1,0 +1,159 @@
+"""CPU oracles for linearizability — the differential-testing anchors.
+
+Two independent implementations, used to validate the TPU kernel
+(SURVEY.md §4.4 tier 5: same histories -> identical verdicts):
+
+1. ``check_events`` — set-based frontier search over the same event
+   stream the TPU kernel consumes. Unbounded frontier (Python sets), so
+   it never overflows; this is the scalable reference (the knossos-wgl
+   role, ref: jepsen/src/jepsen/checker.clj:141-144).
+2. ``check_brute`` — exhaustive enumeration over linearization orders
+   straight from op records, for tiny histories only. Algorithmically
+   unrelated to the frontier search; ground truth for property tests.
+
+Frontier semantics (Wing–Gong / Lowe just-in-time linearization):
+a configuration is (state, mask-of-linearized-open-ops). Closure expands
+configurations by linearizing any open, not-yet-linearized op; a RETURN
+of op i filters to configurations with i linearized (then clears i's bit
+so its slot can be recycled). The history is linearizable iff the
+frontier is non-empty after the final event.
+"""
+
+from __future__ import annotations
+
+from itertools import permutations
+from typing import Any, Iterable, List, Optional, Set, Tuple
+
+from jepsen_tpu.checker.events import EV_INVOKE, EV_NOP, EV_RETURN, EventStream
+from jepsen_tpu.checker.models import Model, model as get_model
+
+
+def _closure(
+    frontier: Set[Tuple[int, int]],
+    open_ops: dict,
+    step_py,
+) -> Set[Tuple[int, int]]:
+    """All configurations reachable by linearizing open ops, in any order."""
+    seen = set(frontier)
+    work = list(frontier)
+    while work:
+        state, mask = work.pop()
+        for s, (f, a, b) in open_ops.items():
+            if (mask >> s) & 1:
+                continue
+            ok, state2 = step_py(state, f, a, b)
+            if ok:
+                cfg = (state2, mask | (1 << s))
+                if cfg not in seen:
+                    seen.add(cfg)
+                    work.append(cfg)
+    return seen
+
+
+def check_events(
+    events: EventStream,
+    model: Any = "cas-register",
+    return_stats: bool = False,
+):
+    """Frontier-search linearizability verdict over an event stream.
+
+    Returns bool, or (bool, stats) with max frontier size when
+    return_stats is set.
+    """
+    m: Model = get_model(model)
+    step = m.step_py
+    frontier: Set[Tuple[int, int]] = {(events.init_state, 0)}
+    open_ops: dict = {}
+    max_frontier = 1
+
+    for i in range(len(events)):
+        kind = int(events.kind[i])
+        if kind == EV_NOP:
+            continue
+        s = int(events.slot[i])
+        if kind == EV_INVOKE:
+            open_ops[s] = (int(events.f[i]), int(events.a[i]), int(events.b[i]))
+        else:  # EV_RETURN of the op in slot s
+            frontier = _closure(frontier, open_ops, step)
+            max_frontier = max(max_frontier, len(frontier))
+            frontier = {
+                (state, mask & ~(1 << s))
+                for state, mask in frontier
+                if (mask >> s) & 1
+            }
+            del open_ops[s]
+            if not frontier:
+                if return_stats:
+                    return False, {"max_frontier": max_frontier, "failed_at": i}
+                return False
+    if return_stats:
+        return True, {"max_frontier": max_frontier, "failed_at": None}
+    return True
+
+
+# -- brute-force ground truth (tiny histories only) --------------------------
+
+
+def check_brute(
+    events: EventStream,
+    model: Any = "cas-register",
+    max_ops: int = 8,
+) -> bool:
+    """Exhaustively test every linearization order consistent with the
+    event stream's real-time partial order. Crashed ops (no RETURN) may
+    be placed anywhere after their invocation or omitted entirely.
+
+    O(n!) — guarded by max_ops.
+    """
+    m: Model = get_model(model)
+    step = m.step_py
+
+    # Reconstruct ops from the event stream: (f, a, b, t_inv, t_ret|None).
+    ops: List[list] = []
+    open_by_slot: dict = {}
+    for i in range(len(events)):
+        kind = int(events.kind[i])
+        if kind == EV_NOP:
+            continue
+        s = int(events.slot[i])
+        if kind == EV_INVOKE:
+            op = [int(events.f[i]), int(events.a[i]), int(events.b[i]), i, None]
+            open_by_slot[s] = op
+            ops.append(op)
+        else:
+            open_by_slot.pop(s)[4] = i
+
+    if len(ops) > max_ops:
+        raise ValueError(f"brute force capped at {max_ops} ops, got {len(ops)}")
+
+    completed = [i for i, op in enumerate(ops) if op[4] is not None]
+    crashed = [i for i, op in enumerate(ops) if op[4] is None]
+
+    def order_ok(order: Iterable[int]) -> bool:
+        # Real-time: if x returned before y invoked, x must precede y.
+        pos = {op_id: k for k, op_id in enumerate(order)}
+        for x in pos:
+            for y in pos:
+                rx = ops[x][4]
+                if rx is not None and rx < ops[y][3] and pos[x] > pos[y]:
+                    return False
+        return True
+
+    def run_ok(order: Iterable[int]) -> bool:
+        state = events.init_state
+        for op_id in order:
+            f, a, b = ops[op_id][:3]
+            ok, state = step(state, f, a, b)
+            if not ok:
+                return False
+        return True
+
+    # Choose any subset of crashed ops to take effect.
+    for subset_bits in range(1 << len(crashed)):
+        chosen = completed + [
+            c for j, c in enumerate(crashed) if (subset_bits >> j) & 1
+        ]
+        for order in permutations(chosen):
+            if order_ok(order) and run_ok(order):
+                return True
+    return False

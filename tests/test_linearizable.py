@@ -1,0 +1,364 @@
+"""Linearizability engine tests: known verdicts, crash semantics, and
+three-way differential testing (brute-force ⟷ CPU oracle ⟷ JAX kernel).
+
+This is tier 5 of the blueprint's pyramid (SURVEY.md §4.4): same
+histories -> identical verdicts across independent implementations,
+standing in for the reference's reliance on knossos's own test suite.
+"""
+
+import random
+
+import pytest
+
+from jepsen_tpu.checker.events import history_to_events
+from jepsen_tpu.checker.linearizable import (
+    LinearizableChecker,
+    check_events_bucketed,
+)
+from jepsen_tpu.checker.wgl_jax import check_events_jax
+from jepsen_tpu.checker.wgl_oracle import check_brute, check_events
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.ops import fail_op, info_op, invoke_op, ok_op
+
+
+def H(*ops):
+    return History(list(ops))
+
+
+# -- known histories ---------------------------------------------------------
+
+
+def test_empty_history_valid():
+    assert check_events_bucketed(history_to_events(H()))["valid?"] is True
+
+
+def test_sequential_rw_valid():
+    h = H(
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(0, "read"),
+        ok_op(0, "read", 1),
+    )
+    assert check_events_bucketed(history_to_events(h))["valid?"] is True
+
+
+def test_stale_read_invalid():
+    # write 1 completes strictly before the read begins; read sees initial.
+    h = H(
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(0, "read"),
+        ok_op(0, "read", None),
+    )
+    assert check_events_bucketed(history_to_events(h))["valid?"] is False
+
+
+def test_concurrent_read_of_either_value_valid():
+    # Read overlaps the write: may observe old or new value.
+    for observed in (None, 1):
+        h = H(
+            invoke_op(0, "read"),
+            invoke_op(1, "write", 1),
+            ok_op(1, "write", 1),
+            ok_op(0, "read", observed),
+        )
+        assert check_events_bucketed(history_to_events(h))["valid?"] is True
+
+
+def test_read_of_unwritten_value_invalid():
+    h = H(
+        invoke_op(0, "read"),
+        ok_op(0, "read", 42),
+    )
+    assert check_events_bucketed(history_to_events(h))["valid?"] is False
+
+
+def test_cas_success_chain_valid():
+    h = H(
+        invoke_op(0, "write", 0),
+        ok_op(0, "write", 0),
+        invoke_op(0, "cas", [0, 1]),
+        ok_op(0, "cas", [0, 1]),
+        invoke_op(0, "read"),
+        ok_op(0, "read", 1),
+    )
+    assert check_events_bucketed(history_to_events(h))["valid?"] is True
+
+
+def test_cas_from_wrong_value_invalid():
+    h = H(
+        invoke_op(0, "write", 0),
+        ok_op(0, "write", 0),
+        invoke_op(0, "cas", [5, 1]),
+        ok_op(0, "cas", [5, 1]),
+    )
+    assert check_events_bucketed(history_to_events(h))["valid?"] is False
+
+
+def test_failed_op_never_happened():
+    # The failed write must NOT be visible to the read.
+    h = H(
+        invoke_op(0, "write", 7),
+        fail_op(0, "write", 7),
+        invoke_op(0, "read"),
+        ok_op(0, "read", None),
+    )
+    assert check_events_bucketed(history_to_events(h))["valid?"] is True
+    # ...and a read observing it is invalid.
+    h2 = H(
+        invoke_op(0, "write", 7),
+        fail_op(0, "write", 7),
+        invoke_op(0, "read"),
+        ok_op(0, "read", 7),
+    )
+    assert check_events_bucketed(history_to_events(h2))["valid?"] is False
+
+
+def test_crashed_write_may_or_may_not_take_effect():
+    # :info write — both observations are legal, even much later.
+    for observed in (None, 7):
+        h = H(
+            invoke_op(0, "write", 7),
+            info_op(0, "write", 7),
+            invoke_op(1, "read"),
+            ok_op(1, "read", observed),
+            invoke_op(1, "read"),
+            ok_op(1, "read", observed),
+        )
+        assert check_events_bucketed(history_to_events(h))["valid?"] is True
+
+
+def test_crashed_write_cannot_unhappen():
+    # Once observed, the crashed write is linearized: a later read of the
+    # initial value is invalid (register never reverts).
+    h = H(
+        invoke_op(0, "write", 7),
+        info_op(0, "write", 7),
+        invoke_op(1, "read"),
+        ok_op(1, "read", 7),
+        invoke_op(1, "read"),
+        ok_op(1, "read", None),
+    )
+    assert check_events_bucketed(history_to_events(h))["valid?"] is False
+
+
+def test_info_op_stays_concurrent_with_everything_after():
+    # Crashed cas [0,1] can linearize between the two reads.
+    h = H(
+        invoke_op(0, "write", 0),
+        ok_op(0, "write", 0),
+        invoke_op(1, "cas", [0, 1]),
+        info_op(1, "cas", [0, 1]),
+        invoke_op(2, "read"),
+        ok_op(2, "read", 0),
+        invoke_op(2, "read"),
+        ok_op(2, "read", 1),
+    )
+    assert check_events_bucketed(history_to_events(h))["valid?"] is True
+
+
+def test_register_model_rejects_cas():
+    h = H(
+        invoke_op(0, "write", 0),
+        ok_op(0, "write", 0),
+        invoke_op(0, "cas", [0, 1]),
+        ok_op(0, "cas", [0, 1]),
+    )
+    ev = history_to_events(h, model="register")
+    assert check_events_bucketed(ev, model="register")["valid?"] is False
+
+
+def test_list_valued_register_roundtrip_valid():
+    # A 2-element list written to the register is a plain value, not a
+    # cas pair: write [1,2] then read [1,2] must be linearizable.
+    h = H(
+        invoke_op(0, "write", [1, 2]),
+        ok_op(0, "write", [1, 2]),
+        invoke_op(0, "read"),
+        ok_op(0, "read", [1, 2]),
+    )
+    assert check_events_bucketed(history_to_events(h))["valid?"] is True
+
+
+def test_bool_and_int_values_stay_distinct():
+    # write True then read 1 must be invalid: True and 1 are distinct
+    # values (typed interning, matching the columnar encoder).
+    h = H(
+        invoke_op(0, "write", True),
+        ok_op(0, "write", True),
+        invoke_op(0, "read"),
+        ok_op(0, "read", 1),
+    )
+    assert check_events_bucketed(history_to_events(h))["valid?"] is False
+
+
+# -- random history generator ------------------------------------------------
+
+
+def gen_history(
+    rng: random.Random,
+    n_ops: int = 20,
+    n_procs: int = 3,
+    n_values: int = 3,
+    p_crash: float = 0.05,
+    p_early: float = 0.5,
+):
+    """Simulate a real linearizable CAS register under concurrency.
+
+    Each op linearizes either at invocation (p_early) or at completion —
+    both legal points — so generated histories are valid by construction.
+    """
+    state = None
+    ops = []
+    pending = {}  # process -> (f, value, result_fn applied?, result)
+    procs = list(range(n_procs))
+    next_proc = n_procs
+    emitted = 0
+
+    def apply(f, v):
+        nonlocal state
+        if f == "read":
+            return True, state
+        if f == "write":
+            state = v
+            return True, v
+        if f == "cas":
+            if state == v[0]:
+                state = v[1]
+                return True, v
+            return False, v
+
+    while emitted < n_ops or pending:
+        p = rng.choice(procs)
+        if p in pending:
+            f, v, applied, res = pending.pop(p)
+            if rng.random() < p_crash:
+                ops.append(info_op(p, f, v))
+                procs.remove(p)  # retire crashed process
+                procs.append(next_proc)
+                next_proc += 1
+                continue
+            if not applied:
+                okp, res = apply(f, v)
+            else:
+                okp = res is not False
+            if f == "read":
+                ops.append(ok_op(p, "read", res))
+            elif f == "write":
+                ops.append(ok_op(p, "write", v))
+            elif okp:
+                ops.append(ok_op(p, "cas", v))
+            else:
+                ops.append(fail_op(p, "cas", v))
+        elif emitted < n_ops:
+            f = rng.choice(["read", "write", "cas"])
+            v = (
+                None
+                if f == "read"
+                else (
+                    rng.randrange(n_values)
+                    if f == "write"
+                    else [rng.randrange(n_values), rng.randrange(n_values)]
+                )
+            )
+            applied, res = False, None
+            if rng.random() < 0.5:  # linearize at invocation
+                okp, res = apply(f, v)
+                applied = True
+                if f == "cas" and not okp:
+                    res = False
+            ops.append(invoke_op(p, f, v))
+            pending[p] = (f, v, applied, res)
+            emitted += 1
+    return History(ops)
+
+
+def corrupt(h: History, rng: random.Random, n_values: int = 3) -> History:
+    """Flip one ok-read's observed value — usually makes it invalid."""
+    ok_reads = [i for i, o in enumerate(h.ops) if o.is_ok and o.f == "read"]
+    if not ok_reads:
+        return h
+    i = rng.choice(ok_reads)
+    old = h.ops[i].value
+    choices = [v for v in list(range(n_values)) + [None] if v != old]
+    new_ops = list(h.ops)
+    new_ops[i] = new_ops[i].with_(value=rng.choice(choices))
+    return History(new_ops, indexed=True)
+
+
+# -- differential tests ------------------------------------------------------
+
+
+def test_generated_histories_are_valid():
+    for seed in range(30):
+        rng = random.Random(seed)
+        h = gen_history(rng, n_ops=25, n_procs=4)
+        ev = history_to_events(h)
+        assert check_events(ev) is True, f"seed {seed}"
+
+
+def test_oracle_matches_brute_force():
+    agree_invalid = 0
+    for seed in range(120):
+        rng = random.Random(1000 + seed)
+        h = gen_history(rng, n_ops=5, n_procs=3)
+        if rng.random() < 0.6:
+            h = corrupt(h, rng)
+        ev = history_to_events(h)
+        want = check_brute(ev)
+        got = check_events(ev)
+        assert got == want, f"seed {seed}: oracle={got} brute={want}"
+        if not want:
+            agree_invalid += 1
+    assert agree_invalid > 5  # the corpus actually exercises invalidity
+
+
+def test_jax_matches_oracle():
+    n_invalid = 0
+    for seed in range(60):
+        rng = random.Random(2000 + seed)
+        h = gen_history(rng, n_ops=30, n_procs=4)
+        if seed % 2:
+            h = corrupt(h, rng)
+        ev = history_to_events(h)
+        want = check_events(ev)
+        got = check_events_bucketed(ev)
+        assert got["valid?"] == want, f"seed {seed}: jax={got} oracle={want}"
+        if not want:
+            n_invalid += 1
+    assert n_invalid > 5
+
+
+def test_jax_matches_oracle_with_crashes():
+    for seed in range(30):
+        rng = random.Random(3000 + seed)
+        h = gen_history(rng, n_ops=20, n_procs=4, p_crash=0.25)
+        if seed % 3 == 0:
+            h = corrupt(h, rng)
+        ev = history_to_events(h)
+        want = check_events(ev)
+        got = check_events_bucketed(ev)
+        assert got["valid?"] == want, f"seed {seed}"
+
+
+def test_checker_protocol_adapter():
+    h = H(
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(0, "read"),
+        ok_op(0, "read", 1),
+    )
+    out = LinearizableChecker().check({}, h)
+    assert out["valid?"] is True
+    assert out["n_ops"] == 2
+    assert out["method"] in ("tpu-wgl", "cpu-oracle")
+
+
+def test_small_frontier_escalation_still_definite():
+    # Tiny K forces overflow on a busy history; verdict must stay correct.
+    rng = random.Random(7)
+    h = gen_history(rng, n_ops=40, n_procs=6)
+    ev = history_to_events(h)
+    want = check_events(ev)
+    got = check_events_bucketed(ev, k_ladder=(2, 64))
+    assert got["valid?"] == want
