@@ -1,0 +1,73 @@
+"""Benchmark: BASELINE config 1 — etcd-style single-key CAS register,
+1k-op recorded history, verified end-to-end by the TPU WGL engine.
+
+Prints ONE JSON line:
+  {"metric": "ops_verified_per_sec", "value": N, "unit": "ops/s",
+   "vs_baseline": M}
+
+vs_baseline is the speedup over the CPU frontier oracle checking the
+same event stream on this host — the stand-in for knossos.wgl's role
+(BASELINE.md: the reference delegates linearizability to knossos on the
+control-node JVM; no published numbers exist, so the measured CPU oracle
+is the honest comparison point).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+
+    from jepsen_tpu.checker.events import history_to_events
+    from jepsen_tpu.checker.linearizable import check_events_bucketed
+    from jepsen_tpu.checker.wgl_oracle import check_events as oracle_check
+    from jepsen_tpu.sim import gen_register_history
+
+    n_ops = 1000
+    h = gen_register_history(
+        random.Random(42), n_ops=n_ops, n_procs=5, p_crash=0.01
+    )
+    ev = history_to_events(h)
+
+    # Warmup: compile the kernel for this shape bucket.
+    r = check_events_bucketed(ev)
+    assert r["valid?"] is True, r
+
+    runs = 5
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        r = check_events_bucketed(ev)
+    tpu_wall = (time.perf_counter() - t0) / runs
+    assert r["valid?"] is True, r
+
+    t0 = time.perf_counter()
+    oracle_valid = oracle_check(ev)
+    oracle_wall = time.perf_counter() - t0
+    assert oracle_valid is True
+
+    value = ev.n_ops / tpu_wall
+    print(
+        f"devices={jax.devices()} n_ops={ev.n_ops} window={ev.window} "
+        f"events={len(ev)} tpu_wall={tpu_wall:.4f}s "
+        f"oracle_wall={oracle_wall:.4f}s method={r['method']}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "ops_verified_per_sec",
+                "value": round(value, 1),
+                "unit": "ops/s",
+                "vs_baseline": round(oracle_wall / tpu_wall, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -98,6 +98,90 @@ class EventStream:
         )
 
 
+@dataclass
+class ReturnSteps:
+    """Event stream precompiled into per-RETURN scan steps.
+
+    Only RETURN events mutate the WGL frontier, so the host bakes the
+    INVOKE bookkeeping into per-return snapshots of the open-op window:
+    the kernel scans [n_steps] rows with a frontier-only carry and zero
+    control flow over event kinds.
+    """
+
+    occ: np.ndarray  # [n, W] bool — slot occupied at this return
+    f: np.ndarray  # [n, W] int32 — open op's model f-code per slot
+    a: np.ndarray  # [n, W] int32
+    b: np.ndarray  # [n, W] int32
+    slot: np.ndarray  # [n] int32 — the returning slot
+    live: np.ndarray  # [n] bool — False rows are padding
+    init_state: int
+    W: int
+
+    def __len__(self) -> int:
+        return int(self.slot.shape[0])
+
+    def padded(self, n: int) -> "ReturnSteps":
+        cur = len(self)
+        if n < cur:
+            raise ValueError(f"cannot pad {cur} steps down to {n}")
+        if n == cur:
+            return self
+        pad = n - cur
+        return ReturnSteps(
+            occ=np.concatenate([self.occ, np.zeros((pad, self.W), bool)]),
+            f=np.concatenate([self.f, np.zeros((pad, self.W), np.int32)]),
+            a=np.concatenate([self.a, np.zeros((pad, self.W), np.int32)]),
+            b=np.concatenate([self.b, np.zeros((pad, self.W), np.int32)]),
+            slot=np.concatenate([self.slot, np.zeros(pad, np.int32)]),
+            live=np.concatenate([self.live, np.zeros(pad, bool)]),
+            init_state=self.init_state,
+            W=self.W,
+        )
+
+
+def events_to_steps(events: EventStream, W: int) -> ReturnSteps:
+    """Precompile an event stream into per-return window snapshots."""
+    if events.window > W:
+        raise ValueError(f"window {events.window} exceeds W={W}")
+    n_ret = int(np.sum(events.kind == EV_RETURN))
+    occ = np.zeros(W, bool)
+    sf = np.zeros(W, np.int32)
+    sa = np.zeros(W, np.int32)
+    sb = np.zeros(W, np.int32)
+    out_occ = np.zeros((n_ret, W), bool)
+    out_f = np.zeros((n_ret, W), np.int32)
+    out_a = np.zeros((n_ret, W), np.int32)
+    out_b = np.zeros((n_ret, W), np.int32)
+    out_slot = np.zeros(n_ret, np.int32)
+    j = 0
+    for i in range(len(events)):
+        kind = int(events.kind[i])
+        s = int(events.slot[i])
+        if kind == EV_INVOKE:
+            occ[s] = True
+            sf[s] = events.f[i]
+            sa[s] = events.a[i]
+            sb[s] = events.b[i]
+        elif kind == EV_RETURN:
+            out_occ[j] = occ
+            out_f[j] = sf
+            out_a[j] = sa
+            out_b[j] = sb
+            out_slot[j] = s
+            j += 1
+            occ[s] = False
+    return ReturnSteps(
+        occ=out_occ,
+        f=out_f,
+        a=out_a,
+        b=out_b,
+        slot=out_slot,
+        live=np.ones(n_ret, bool),
+        init_state=events.init_state,
+        W=W,
+    )
+
+
 def history_to_events(
     history: History,
     model: Any = "cas-register",

@@ -28,10 +28,11 @@ from typing import Any, Optional
 from jepsen_tpu.checker.events import (
     EventStream,
     WindowOverflow,
+    events_to_steps,
     history_to_events,
 )
 from jepsen_tpu.checker.wgl_oracle import check_events as oracle_check
-from jepsen_tpu.checker.wgl_jax import check_events_jax
+from jepsen_tpu.checker.wgl_jax import check_steps_jax
 
 #: K escalation ladder: frontier capacities tried in order.
 K_LADDER = (64, 512, 4096)
@@ -74,10 +75,11 @@ def check_events_bucketed(
             "reason": f"window {events.window} exceeds {W_BUCKETS[-1]} slots",
         }
 
-    padded = events.padded(_bucket_events(len(events)))
+    steps = events_to_steps(events, W=W)
+    steps = steps.padded(_bucket_events(max(len(steps), 1)))
     escalations = 0
     for K in k_ladder:
-        alive, overflow = check_events_jax(padded, model=model, K=K, W=W)
+        alive, overflow = check_steps_jax(steps, model=model, K=K)
         if alive or not overflow:
             return {
                 "valid?": alive,

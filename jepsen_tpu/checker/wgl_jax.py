@@ -9,15 +9,19 @@ Formulation (just-in-time linearization, tensorized):
   and an int32 bitset of which currently-open ops have linearized.
 - The frontier is a fixed-size padded buffer of K configurations with a
   validity mask — no hash tables; set semantics come from lexicographic
-  sort + neighbor-compare dedup + stable compaction (all MXU/VPU-friendly
+  sort + neighbor-compare dedup + stable compaction (all TPU-friendly
   primitives).
-- The event stream is consumed by one `lax.scan`. INVOKE events only
-  update the open-slot tables. RETURN events run the closure (a
-  `lax.while_loop` of vectorized expand→dedup rounds: each round tries to
-  linearize every open op against every configuration at once, a [K, W]
-  broadcast of the model step), then filter to configurations with the
-  returning op linearized, clear its bit, and recycle the slot.
-- Closure convergence: the within-event frontier grows monotonically
+- Only RETURN events mutate the frontier, so the host precompiles the
+  event stream into *return steps* (events.events_to_steps): per return,
+  a snapshot of the open-op window (occ/f/a/b, each [W]) and the
+  returning slot. One `lax.scan` consumes [n_steps, ...] arrays with a
+  frontier-only carry — INVOKE bookkeeping never touches the device and
+  costs zero scan iterations.
+- Each step runs the closure (a `lax.while_loop` of vectorized
+  expand→dedup rounds: every open op tried against every configuration
+  at once, a [K, W] broadcast of the model step), then filters to
+  configurations with the returning op linearized and clears its bit.
+- Closure convergence: the within-step frontier grows monotonically
   (originals are always kept), so `count == prev_count` is a fixpoint;
   the loop is also bounded by W+1 rounds.
 
@@ -39,7 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from jepsen_tpu.checker.events import EV_INVOKE, EV_NOP, EV_RETURN, EventStream
+from jepsen_tpu.checker.events import EventStream, ReturnSteps, events_to_steps
 from jepsen_tpu.checker.models import model as get_model
 
 SENTINEL = jnp.int32(2**31 - 1)
@@ -63,7 +67,8 @@ def _dedup_compact(s, m, v):
 
 
 def _make_step(model_name: str, K: int, W: int):
-    """Build the scan step function for static (model, K, W)."""
+    """Build the scan step for static (model, K, W). The step consumes
+    one return-step: (occ[W], f[W], a[W], b[W], slot, live)."""
     step_jax = get_model(model_name).step_jax
     slot_bits = jnp.left_shift(jnp.int32(1), jnp.arange(W, dtype=jnp.int32))
 
@@ -93,72 +98,77 @@ def _make_step(model_name: str, K: int, W: int):
             fs, fm, fv, ovf2 = closure_round(fs, fm, fv, occ, sf, sa, sb)
             return (fs, fm, fv, fv.sum(), cnt, ovf | ovf2, i + 1)
 
-        init = (fs, fm, fv, fv.sum(), jnp.int32(-1), jnp.bool_(False), 0)
+        # Scalars derive from fv (not fresh constants) so they carry the
+        # same varying-axes type as the data under shard_map.
+        cnt0 = fv.sum()
+        init = (fs, fm, fv, cnt0, jnp.full_like(cnt0, -1), jnp.any(fv) & False, 0)
         fs, fm, fv, _, _, ovf, _ = lax.while_loop(cond, body, init)
         return fs, fm, fv, ovf
 
-    def invoke_branch(carry, ev):
-        fs, fm, fv, occ, sf, sa, sb, alive, ovf = carry
-        _, slot, f, a, b = ev
-        occ = occ.at[slot].set(True)
-        sf = sf.at[slot].set(f)
-        sa = sa.at[slot].set(a)
-        sb = sb.at[slot].set(b)
-        return (fs, fm, fv, occ, sf, sa, sb, alive, ovf)
+    def step(carry, xs):
+        fs, fm, fv, alive, ovf = carry
+        occ, sf, sa, sb, slot, live = xs
 
-    def return_branch(carry, ev):
-        fs, fm, fv, occ, sf, sa, sb, alive, ovf = carry
-        _, slot, _, _, _ = ev
-
-        def live(_):
+        def work(_):
             cfs, cfm, cfv, covf = closure(fs, fm, fv, occ, sf, sa, sb)
             bit = jnp.left_shift(jnp.int32(1), slot)
             cfv = cfv & ((cfm & bit) != 0)
             cfm = cfm & ~bit
             # Clearing the bit can merge configs; re-dedup so duplicate
             # rows don't eat frontier capacity.
-            cfs2, cfm2, cfv2 = _dedup_compact(cfs, cfm, cfv)
-            return cfs2, cfm2, cfv2, covf
+            return _dedup_compact(cfs, cfm, cfv) + (covf,)
 
-        def dead(_):
-            return fs, fm, fv, jnp.bool_(False)
+        def skip(_):
+            return fs, fm, fv, live & False
 
-        fs, fm, fv, covf = lax.cond(alive, live, dead, None)
-        occ = occ.at[slot].set(False)
-        alive = alive & jnp.any(fv)
-        return (fs, fm, fv, occ, sf, sa, sb, alive, ovf | covf)
-
-    def nop_branch(carry, ev):
-        return carry
-
-    def step(carry, ev):
-        kind = ev[0]
-        carry = lax.switch(
-            kind,
-            [invoke_branch, return_branch, nop_branch],
-            carry,
-            ev,
-        )
-        return carry, None
+        fs2, fm2, fv2, covf = lax.cond(alive & live, work, skip, None)
+        alive2 = alive & (jnp.any(fv2) | ~live)
+        return (fs2, fm2, fv2, alive2, ovf | covf), None
 
     return step
 
 
-@functools.partial(jax.jit, static_argnames=("model_name", "K", "W"))
-def _wgl_scan(kind, slot, f, a, b, init_state, model_name: str, K: int, W: int):
+def wgl_scan_steps(occ, sf, sa, sb, slot, live, init_state, model_name, K, W):
+    """Unjitted scan over precompiled return steps -> (alive, overflow).
+    Pure JAX: safe to jit, vmap (batch over keys), or shard_map directly.
+
+    occ/sf/sa/sb: [n, W]; slot/live: [n]; live=False rows are padding.
+    """
     step = _make_step(model_name, K, W)
+    # All carry values derive from init_state (an input) so they inherit
+    # its varying-axes type under shard_map; fresh constants would trip
+    # the manual-axes consistency check.
     fs = jnp.full((K,), SENTINEL, jnp.int32).at[0].set(init_state)
-    fm = jnp.zeros((K,), jnp.int32)
-    fv = jnp.zeros((K,), bool).at[0].set(True)
-    occ = jnp.zeros((W,), bool)
-    sf = jnp.zeros((W,), jnp.int32)
-    sa = jnp.zeros((W,), jnp.int32)
-    sb = jnp.zeros((W,), jnp.int32)
-    carry = (fs, fm, fv, occ, sf, sa, sb, jnp.bool_(True), jnp.bool_(False))
-    events = jnp.stack([kind, slot, f, a, b], axis=1)
-    carry, _ = lax.scan(step, carry, events)
-    *_, alive, overflow = carry
+    fm = jnp.zeros((K,), jnp.int32) + (init_state & 0)
+    fv = jnp.zeros((K,), bool).at[0].set(init_state == init_state)
+    carry = (fs, fm, fv, init_state == init_state, init_state != init_state)
+    carry, _ = lax.scan(step, carry, (occ, sf, sa, sb, slot, live))
+    _, _, _, alive, overflow = carry
     return alive, overflow
+
+
+_wgl_scan_steps = functools.partial(
+    jax.jit, static_argnames=("model_name", "K", "W")
+)(wgl_scan_steps)
+
+
+def check_steps_jax(
+    steps: ReturnSteps, model: str = "cas-register", K: int = 64
+) -> Tuple[bool, bool]:
+    """Run the kernel over precompiled return steps: (alive, overflow)."""
+    alive, overflow = _wgl_scan_steps(
+        jnp.asarray(steps.occ),
+        jnp.asarray(steps.f),
+        jnp.asarray(steps.a),
+        jnp.asarray(steps.b),
+        jnp.asarray(steps.slot),
+        jnp.asarray(steps.live),
+        jnp.int32(steps.init_state),
+        model_name=model if isinstance(model, str) else model.name,
+        K=K,
+        W=steps.W,
+    )
+    return bool(alive), bool(overflow)
 
 
 def check_events_jax(
@@ -167,7 +177,7 @@ def check_events_jax(
     K: int = 64,
     W: int | None = None,
 ) -> Tuple[bool, bool]:
-    """Run the kernel over an event stream. Returns (alive, overflow).
+    """Compatibility driver: EventStream in, (alive, overflow) out.
 
     alive=True is always trustworthy; alive=False is trustworthy only
     when overflow=False (see module docstring).
@@ -175,15 +185,5 @@ def check_events_jax(
     W = W if W is not None else max(events.window, 1)
     if events.window > W:
         raise ValueError(f"window {events.window} exceeds kernel W={W}")
-    alive, overflow = _wgl_scan(
-        jnp.asarray(events.kind),
-        jnp.asarray(events.slot),
-        jnp.asarray(events.f),
-        jnp.asarray(events.a),
-        jnp.asarray(events.b),
-        jnp.int32(events.init_state),
-        model_name=model if isinstance(model, str) else model.name,
-        K=K,
-        W=W,
-    )
-    return bool(alive), bool(overflow)
+    steps = events_to_steps(events, W=W)
+    return check_steps_jax(steps, model=model, K=K)

@@ -1,8 +1,14 @@
 """Test configuration.
 
-Force JAX onto a virtual 8-device CPU mesh BEFORE jax is imported anywhere:
-multi-chip sharding paths (pjit/shard_map over a Mesh) are exercised on CPU
-devices in CI; real-TPU execution is covered by bench.py / the driver.
+Force JAX onto a virtual 8-device CPU mesh BEFORE any backend
+initializes: multi-chip sharding paths (pjit/shard_map over a Mesh) are
+exercised on CPU devices in CI; real-TPU execution is covered by
+bench.py / the driver.
+
+Env vars alone are not enough here: an ambient TPU plugin (axon) can
+override JAX_PLATFORMS during plugin discovery, so we also pin the
+jax_platforms config explicitly after import — this wins as long as it
+runs before the first device query.
 """
 
 import os
@@ -13,3 +19,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

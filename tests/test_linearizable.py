@@ -192,98 +192,9 @@ def test_bool_and_int_values_stay_distinct():
     assert check_events_bucketed(history_to_events(h))["valid?"] is False
 
 
-# -- random history generator ------------------------------------------------
+# -- random history generator (jepsen_tpu.sim) -------------------------------
 
-
-def gen_history(
-    rng: random.Random,
-    n_ops: int = 20,
-    n_procs: int = 3,
-    n_values: int = 3,
-    p_crash: float = 0.05,
-    p_early: float = 0.5,
-):
-    """Simulate a real linearizable CAS register under concurrency.
-
-    Each op linearizes either at invocation (p_early) or at completion —
-    both legal points — so generated histories are valid by construction.
-    """
-    state = None
-    ops = []
-    pending = {}  # process -> (f, value, result_fn applied?, result)
-    procs = list(range(n_procs))
-    next_proc = n_procs
-    emitted = 0
-
-    def apply(f, v):
-        nonlocal state
-        if f == "read":
-            return True, state
-        if f == "write":
-            state = v
-            return True, v
-        if f == "cas":
-            if state == v[0]:
-                state = v[1]
-                return True, v
-            return False, v
-
-    while emitted < n_ops or pending:
-        p = rng.choice(procs)
-        if p in pending:
-            f, v, applied, res = pending.pop(p)
-            if rng.random() < p_crash:
-                ops.append(info_op(p, f, v))
-                procs.remove(p)  # retire crashed process
-                procs.append(next_proc)
-                next_proc += 1
-                continue
-            if not applied:
-                okp, res = apply(f, v)
-            else:
-                okp = res is not False
-            if f == "read":
-                ops.append(ok_op(p, "read", res))
-            elif f == "write":
-                ops.append(ok_op(p, "write", v))
-            elif okp:
-                ops.append(ok_op(p, "cas", v))
-            else:
-                ops.append(fail_op(p, "cas", v))
-        elif emitted < n_ops:
-            f = rng.choice(["read", "write", "cas"])
-            v = (
-                None
-                if f == "read"
-                else (
-                    rng.randrange(n_values)
-                    if f == "write"
-                    else [rng.randrange(n_values), rng.randrange(n_values)]
-                )
-            )
-            applied, res = False, None
-            if rng.random() < 0.5:  # linearize at invocation
-                okp, res = apply(f, v)
-                applied = True
-                if f == "cas" and not okp:
-                    res = False
-            ops.append(invoke_op(p, f, v))
-            pending[p] = (f, v, applied, res)
-            emitted += 1
-    return History(ops)
-
-
-def corrupt(h: History, rng: random.Random, n_values: int = 3) -> History:
-    """Flip one ok-read's observed value — usually makes it invalid."""
-    ok_reads = [i for i, o in enumerate(h.ops) if o.is_ok and o.f == "read"]
-    if not ok_reads:
-        return h
-    i = rng.choice(ok_reads)
-    old = h.ops[i].value
-    choices = [v for v in list(range(n_values)) + [None] if v != old]
-    new_ops = list(h.ops)
-    new_ops[i] = new_ops[i].with_(value=rng.choice(choices))
-    return History(new_ops, indexed=True)
+from jepsen_tpu.sim import corrupt_history as corrupt, gen_register_history as gen_history
 
 
 # -- differential tests ------------------------------------------------------
