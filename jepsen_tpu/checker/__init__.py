@@ -24,6 +24,20 @@ from jepsen_tpu.checker.linearizable import (
 )
 from jepsen_tpu.checker.events import EventStream, history_to_events
 from jepsen_tpu.checker.models import MODELS, Model, model
+from jepsen_tpu.checker.reductions import (
+    CounterChecker,
+    QueueChecker,
+    SetChecker,
+    SetFullChecker,
+    TotalQueueChecker,
+    UniqueIdsChecker,
+    counter,
+    queue,
+    set_checker,
+    set_full,
+    total_queue,
+    unique_ids,
+)
 
 __all__ = [
     "Checker",
@@ -44,4 +58,16 @@ __all__ = [
     "MODELS",
     "Model",
     "model",
+    "CounterChecker",
+    "QueueChecker",
+    "SetChecker",
+    "SetFullChecker",
+    "TotalQueueChecker",
+    "UniqueIdsChecker",
+    "counter",
+    "queue",
+    "set_checker",
+    "set_full",
+    "total_queue",
+    "unique_ids",
 ]

@@ -173,14 +173,16 @@ def check_keys(
     alive = np.asarray(alive)[:n_real]
     overflow = np.asarray(overflow)[:n_real]
 
+    method = "tpu-wgl-sharded" if mesh is not None else "tpu-wgl-batch"
     out: List[dict] = []
     for i, s in enumerate(streams):
         if alive[i] or not overflow[i]:
             out.append(
                 {
                     "valid?": bool(alive[i]),
-                    "method": "tpu-wgl-sharded",
+                    "method": method,
                     "frontier_k": K,
+                    "escalations": 0,
                 }
             )
         else:

@@ -148,6 +148,10 @@ class ColumnarHistory:
     pair: np.ndarray
     encoder: Encoder
     extra: Dict[str, np.ndarray] = field(default_factory=dict)
+    #: raw numeric value (int64) for arithmetic checkers (counter, bank);
+    #: valid only where num_ok is True — interned codes lose numerics.
+    num: np.ndarray = None  # type: ignore[assignment]
+    num_ok: np.ndarray = None  # type: ignore[assignment]
 
     def __len__(self) -> int:
         return int(self.index.shape[0])
@@ -173,6 +177,8 @@ class ColumnarHistory:
         v0 = np.empty(n, np.int32)
         v1 = np.empty(n, np.int32)
         pairc = np.full(n, -1, np.int32)
+        num = np.zeros(n, np.int64)
+        num_ok = np.zeros(n, bool)
 
         key_codes: Dict[Any, int] = {}
         pairs = history.pairs()
@@ -185,6 +191,11 @@ class ColumnarHistory:
             a, b = enc.encode_payload(op)
             v0[i] = a
             v1[i] = b
+            if isinstance(op.value, (int, np.integer)) and not isinstance(
+                op.value, bool
+            ):
+                num[i] = int(op.value)
+                num_ok[i] = True
             if key_fn is not None:
                 k = key_fn(op)
                 if k is not None:
@@ -206,6 +217,8 @@ class ColumnarHistory:
             v0=v0,
             v1=v1,
             pair=pairc,
+            num=num,
+            num_ok=num_ok,
             encoder=enc,
         )
         ch.extra["key_codes"] = key_codes  # type: ignore[assignment]
@@ -224,6 +237,8 @@ class ColumnarHistory:
             v0=self.v0[mask],
             v1=self.v1[mask],
             pair=self.pair[mask],
+            num=self.num[mask],
+            num_ok=self.num_ok[mask],
             encoder=self.encoder,
             extra=self.extra,
         )

@@ -235,3 +235,29 @@ class NamedLocks:
         lk = self.lock(name)
         with lk:
             yield
+
+
+def integer_interval_set_str(xs) -> str:
+    """Render a set of integers as compact interval notation, e.g.
+    "#{1..3 5 7..9}" (ref: jepsen/src/jepsen/util.clj
+    integer-interval-set-str, used by checker set results). Non-integer
+    collections render as a plain sorted set string."""
+    xs = list(xs)
+    if not xs:
+        return "#{}"
+    if not all(isinstance(x, int) and not isinstance(x, bool) for x in xs):
+        return "#{" + " ".join(repr(x) for x in sorted(xs, key=repr)) + "}"
+    xs = sorted(set(xs))
+    runs = []
+    lo = prev = xs[0]
+    for x in xs[1:]:
+        if x == prev + 1:
+            prev = x
+            continue
+        runs.append((lo, prev))
+        lo = prev = x
+    runs.append((lo, prev))
+    body = " ".join(
+        str(a) if a == b else f"{a}..{b}" for a, b in runs
+    )
+    return "#{" + body + "}"

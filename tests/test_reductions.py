@@ -1,0 +1,470 @@
+"""O(n) checker tests, ported from the reference's
+jepsen/test/jepsen/checker_test.clj (queue-test:13-33,
+total-queue-test:35-88, counter-test:90-167, set-full-test:425-640) plus
+coverage for set and unique-ids (untested in the reference suite).
+"""
+
+from jepsen_tpu.checker.core import UNKNOWN
+from jepsen_tpu.checker.reductions import (
+    CounterChecker,
+    QueueChecker,
+    SetChecker,
+    SetFullChecker,
+    TotalQueueChecker,
+    UniqueIdsChecker,
+)
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.ops import fail_op, invoke_op, ok_op
+
+
+def H(*ops):
+    """Index ops and space times 1 ms apart, like the reference's
+    history helper (checker_test.clj:412-424)."""
+    out = []
+    for i, o in enumerate(ops):
+        out.append(o.with_(index=i, time=i * 1_000_000))
+    return History(out, indexed=True)
+
+
+# -- queue -------------------------------------------------------------------
+
+
+def test_queue_empty():
+    assert QueueChecker().check(None, H(), {})["valid?"] is True
+
+
+def test_queue_possible_enqueue_no_dequeue():
+    h = H(invoke_op(1, "enqueue", 1))
+    assert QueueChecker().check(None, h, {})["valid?"] is True
+
+
+def test_queue_definite_enqueue_no_dequeue():
+    h = H(ok_op(1, "enqueue", 1))
+    assert QueueChecker().check(None, h, {})["valid?"] is True
+
+
+def test_queue_concurrent_enqueue_dequeue():
+    h = H(
+        invoke_op(2, "dequeue"),
+        invoke_op(1, "enqueue", 1),
+        ok_op(2, "dequeue", 1),
+    )
+    assert QueueChecker().check(None, h, {})["valid?"] is True
+
+
+def test_queue_dequeue_without_enqueue():
+    h = H(ok_op(1, "dequeue", 1))
+    assert QueueChecker().check(None, h, {})["valid?"] is False
+
+
+# -- total-queue -------------------------------------------------------------
+
+
+def test_total_queue_empty():
+    assert TotalQueueChecker().check(None, H(), {})["valid?"] is True
+
+
+def test_total_queue_sane():
+    h = H(
+        invoke_op(1, "enqueue", 1),
+        invoke_op(2, "enqueue", 2),
+        ok_op(2, "enqueue", 2),
+        invoke_op(3, "dequeue", 1),
+        ok_op(3, "dequeue", 1),
+        invoke_op(3, "dequeue", 2),
+        ok_op(3, "dequeue", 2),
+    )
+    r = TotalQueueChecker().check(None, h, {})
+    assert r["valid?"] is True
+    assert r["attempt-count"] == 2
+    assert r["acknowledged-count"] == 1
+    assert r["ok-count"] == 2
+    assert r["lost-count"] == 0
+    assert r["unexpected-count"] == 0
+    assert r["duplicated-count"] == 0
+    assert r["recovered-count"] == 1
+    assert r["recovered"] == {1: 1}
+
+
+def test_total_queue_pathological():
+    h = H(
+        invoke_op(1, "enqueue", "hung"),
+        invoke_op(2, "enqueue", "enqueued"),
+        ok_op(2, "enqueue", "enqueued"),
+        invoke_op(3, "enqueue", "dup"),
+        ok_op(3, "enqueue", "dup"),
+        invoke_op(4, "dequeue"),
+        invoke_op(5, "dequeue"),
+        ok_op(5, "dequeue", "wtf"),
+        invoke_op(6, "dequeue"),
+        ok_op(6, "dequeue", "dup"),
+        invoke_op(7, "dequeue"),
+        ok_op(7, "dequeue", "dup"),
+    )
+    r = TotalQueueChecker().check(None, h, {})
+    assert r["valid?"] is False
+    assert r["lost"] == {"enqueued": 1}
+    assert r["unexpected"] == {"wtf": 1}
+    assert r["duplicated"] == {"dup": 1}
+    assert r["acknowledged-count"] == 2
+    assert r["attempt-count"] == 3
+    assert r["ok-count"] == 1
+    assert r["lost-count"] == 1
+    assert r["unexpected-count"] == 1
+    assert r["duplicated-count"] == 1
+    assert r["recovered-count"] == 0
+
+
+def test_total_queue_drain_expansion():
+    h = H(
+        invoke_op(1, "enqueue", 1),
+        ok_op(1, "enqueue", 1),
+        invoke_op(2, "drain"),
+        ok_op(2, "drain", [1]),
+    )
+    r = TotalQueueChecker().check(None, h, {})
+    assert r["valid?"] is True
+    assert r["ok-count"] == 1
+
+
+# -- counter -----------------------------------------------------------------
+
+
+def test_counter_empty():
+    r = CounterChecker().check(None, H(), {})
+    assert r == {"valid?": True, "reads": [], "errors": []}
+
+
+def test_counter_initial_read():
+    h = H(invoke_op(0, "read"), ok_op(0, "read", 0))
+    r = CounterChecker().check(None, h, {})
+    assert r == {"valid?": True, "reads": [[0, 0, 0]], "errors": []}
+
+
+def test_counter_ignores_failed_ops():
+    h = H(
+        invoke_op(0, "add", 1),
+        fail_op(0, "add", 1),
+        invoke_op(0, "read"),
+        ok_op(0, "read", 0),
+    )
+    r = CounterChecker().check(None, h, {})
+    assert r == {"valid?": True, "reads": [[0, 0, 0]], "errors": []}
+
+
+def test_counter_initial_invalid_read():
+    h = H(invoke_op(0, "read"), ok_op(0, "read", 1))
+    r = CounterChecker().check(None, h, {})
+    assert r == {"valid?": False, "reads": [[0, 1, 0]], "errors": [[0, 1, 0]]}
+
+
+def test_counter_interleaved():
+    h = H(
+        invoke_op(0, "read"),
+        invoke_op(1, "add", 1),
+        invoke_op(2, "read"),
+        invoke_op(3, "add", 2),
+        invoke_op(4, "read"),
+        invoke_op(5, "add", 4),
+        invoke_op(6, "read"),
+        invoke_op(7, "add", 8),
+        invoke_op(8, "read"),
+        ok_op(0, "read", 6),
+        ok_op(1, "add", 1),
+        ok_op(2, "read", 0),
+        ok_op(3, "add", 2),
+        ok_op(4, "read", 3),
+        ok_op(5, "add", 4),
+        ok_op(6, "read", 100),
+        ok_op(7, "add", 8),
+        ok_op(8, "read", 15),
+    )
+    r = CounterChecker().check(None, h, {})
+    assert r["valid?"] is False
+    assert r["reads"] == [
+        [0, 6, 15],
+        [0, 0, 15],
+        [0, 3, 15],
+        [0, 100, 15],
+        [0, 15, 15],
+    ]
+    assert r["errors"] == [[0, 100, 15]]
+
+
+def test_counter_rolling():
+    h = H(
+        invoke_op(0, "read"),
+        invoke_op(1, "add", 1),
+        ok_op(0, "read", 0),
+        invoke_op(0, "read"),
+        ok_op(1, "add", 1),
+        invoke_op(1, "add", 2),
+        ok_op(0, "read", 3),
+        invoke_op(0, "read"),
+        ok_op(1, "add", 2),
+        ok_op(0, "read", 5),
+    )
+    r = CounterChecker().check(None, h, {})
+    assert r["valid?"] is False
+    assert r["reads"] == [[0, 0, 1], [0, 3, 3], [1, 5, 3]]
+    assert r["errors"] == [[1, 5, 3]]
+
+
+# -- set ---------------------------------------------------------------------
+
+
+def test_set_never_read_unknown():
+    h = H(invoke_op(0, "add", 0), ok_op(0, "add", 0))
+    assert SetChecker().check(None, h, {})["valid?"] == UNKNOWN
+
+
+def test_set_ok_lost_unexpected_recovered():
+    h = H(
+        invoke_op(0, "add", 0),
+        ok_op(0, "add", 0),
+        invoke_op(0, "add", 1),  # indeterminate, recovered by read
+        invoke_op(0, "add", 2),
+        ok_op(0, "add", 2),  # lost
+        invoke_op(1, "read"),
+        ok_op(1, "read", [0, 1, 5]),  # 5 unexpected
+    )
+    r = SetChecker().check(None, h, {})
+    assert r["valid?"] is False
+    assert r["attempt-count"] == 3
+    assert r["acknowledged-count"] == 2
+    assert r["ok-count"] == 2
+    assert r["lost-count"] == 1
+    assert r["recovered-count"] == 1
+    assert r["unexpected-count"] == 1
+    assert r["lost"] == "#{2}"
+    assert r["unexpected"] == "#{5}"
+    assert r["recovered"] == "#{1}"
+
+
+def test_set_valid():
+    h = H(
+        invoke_op(0, "add", 10),
+        ok_op(0, "add", 10),
+        invoke_op(1, "read"),
+        ok_op(1, "read", [10]),
+    )
+    assert SetChecker().check(None, h, {})["valid?"] is True
+
+
+# -- unique-ids --------------------------------------------------------------
+
+
+def test_unique_ids_valid():
+    h = H(
+        invoke_op(0, "generate"),
+        ok_op(0, "generate", 1),
+        invoke_op(0, "generate"),
+        ok_op(0, "generate", 2),
+    )
+    r = UniqueIdsChecker().check(None, h, {})
+    assert r["valid?"] is True
+    assert r["attempted-count"] == 2
+    assert r["acknowledged-count"] == 2
+    assert r["range"] == [1, 2]
+
+
+def test_unique_ids_duplicates():
+    h = H(
+        invoke_op(0, "generate"),
+        ok_op(0, "generate", 7),
+        invoke_op(0, "generate"),
+        ok_op(0, "generate", 7),
+    )
+    r = UniqueIdsChecker().check(None, h, {})
+    assert r["valid?"] is False
+    assert r["duplicated-count"] == 1
+    assert r["duplicated"] == {7: 2}
+
+
+# -- set-full ----------------------------------------------------------------
+
+
+def SF(*ops):
+    return SetFullChecker().check(None, H(*ops), {})
+
+
+def test_set_full_never_read():
+    r = SF(invoke_op(0, "add", 0), ok_op(0, "add", 0))
+    assert r["valid?"] == UNKNOWN
+    assert r["attempt-count"] == 1
+    assert r["never-read"] == [0]
+    assert r["never-read-count"] == 1
+    assert r["stable-count"] == 0
+    assert r["lost-count"] == 0
+
+
+def test_set_full_never_confirmed_never_read():
+    r = SF(
+        invoke_op(0, "add", 0),
+        invoke_op(1, "read"),
+        ok_op(1, "read", []),
+    )
+    assert r["valid?"] == UNKNOWN
+    assert r["never-read"] == [0]
+
+
+def test_set_full_successful_read_windows():
+    a = invoke_op(0, "add", 0)
+    a_ = ok_op(0, "add", 0)
+    r = invoke_op(1, "read")
+    rp = ok_op(1, "read", [0])
+    for hist in (
+        (r, a, rp, a_),  # concurrent read before
+        (r, a, a_, rp),  # concurrent read outside
+        (a, r, rp, a_),  # concurrent read inside
+        (a, r, a_, rp),  # concurrent read after
+        (a, a_, r, rp),  # subsequent read
+    ):
+        out = SF(*hist)
+        assert out["valid?"] is True, hist
+        assert out["stable-count"] == 1
+        assert out["stable-latencies"] == {0: 0, 0.5: 0, 0.95: 0, 0.99: 0, 1: 0}
+
+
+def test_set_full_absent_read_after_is_lost():
+    r = SF(
+        invoke_op(0, "add", 0),
+        ok_op(0, "add", 0),
+        invoke_op(1, "read"),
+        ok_op(1, "read", []),
+    )
+    assert r["valid?"] is False
+    assert r["lost"] == [0]
+    assert r["lost-count"] == 1
+    assert r["lost-latencies"] == {0: 0, 0.5: 0, 0.95: 0, 0.99: 0, 1: 0}
+
+
+def test_set_full_absent_read_concurrent_is_unknown():
+    a = invoke_op(0, "add", 0)
+    a_ = ok_op(0, "add", 0)
+    r = invoke_op(1, "read")
+    rm = ok_op(1, "read", [])
+    for hist in (
+        (r, a, rm, a_),
+        (r, a, a_, rm),
+        (a, r, rm, a_),
+        (a, r, a_, rm),
+    ):
+        out = SF(*hist)
+        assert out["valid?"] == UNKNOWN, hist
+        assert out["never-read"] == [0]
+
+
+def test_set_full_write_present_missing():
+    a0 = invoke_op(0, "add", 0)
+    a0_ = ok_op(0, "add", 0)
+    a1 = invoke_op(1, "add", 1)
+    a1_ = ok_op(1, "add", 1)
+    r2 = invoke_op(2, "read")
+    r = SF(
+        a0, a1, r2, ok_op(2, "read", [1]),
+        a0_, a1_,
+        r2, ok_op(2, "read", [0, 1]),
+        r2, ok_op(2, "read", [0]),
+        r2, ok_op(2, "read", []),
+    )
+    assert r["valid?"] is False
+    assert r["attempt-count"] == 2
+    assert sorted(r["lost"]) == [0, 1]
+    assert r["lost-count"] == 2
+    assert r["stable-count"] == 0
+    assert r["lost-latencies"] == {0: 3, 0.5: 4, 0.95: 4, 0.99: 4, 1: 4}
+
+
+def test_set_full_write_flutter_stable_lost():
+    a0 = invoke_op(0, "add", 0)
+    a0_ = ok_op(0, "add", 0)
+    a1 = invoke_op(1, "add", 1)
+    a1_ = ok_op(1, "add", 1)
+    r2 = invoke_op(2, "read")
+    r3 = invoke_op(3, "read")
+    # t  0   1    2   3   4              5    6   7   8              9
+    r = SF(
+        a0, a0_, a1, r2, ok_op(2, "read", [1]), a1_, r2, r3,
+        ok_op(3, "read", [1]), ok_op(2, "read", [0]),
+    )
+    assert r["valid?"] is False
+    assert r["lost"] == [0]
+    assert r["stable-count"] == 1
+    assert r["stale"] == [1]
+    assert r["lost-latencies"] == {0: 5, 0.5: 5, 0.95: 5, 0.99: 5, 1: 5}
+    assert r["stable-latencies"] == {0: 2, 0.5: 2, 0.95: 2, 0.99: 2, 1: 2}
+    ws = r["worst-stale"]
+    assert len(ws) == 1
+    assert ws[0]["element"] == 1
+    assert ws[0]["outcome"] == "stable"
+    assert ws[0]["stable-latency"] == 2
+    assert ws[0]["known"].index == 4  # the read that saw 1 pre-ack
+    assert ws[0]["last-absent"].index == 6
+
+
+def test_set_full_duplicates_invalidate():
+    r = SF(
+        invoke_op(0, "add", 0),
+        ok_op(0, "add", 0),
+        invoke_op(1, "read"),
+        ok_op(1, "read", [0, 0]),
+    )
+    assert r["valid?"] is False
+    assert r["duplicated-count"] == 1
+    assert r["duplicated"] == {0: 2}
+
+
+def test_set_full_linearizable_mode_fails_stale():
+    a0 = invoke_op(0, "add", 0)
+    a0_ = ok_op(0, "add", 0)
+    a1 = invoke_op(1, "add", 1)
+    a1_ = ok_op(1, "add", 1)
+    r2 = invoke_op(2, "read")
+    # Element 1: miss then hit after ack -> stale but stable.
+    hist = (
+        a0, a0_, a1, a1_,
+        r2, ok_op(2, "read", [0]),
+        r2, ok_op(2, "read", [0, 1]),
+    )
+    assert SetFullChecker().check(None, H(*hist), {})["valid?"] is True
+    assert (
+        SetFullChecker(linearizable=True).check(None, H(*hist), {})["valid?"]
+        is False
+    )
+
+
+# -- regressions from review -------------------------------------------------
+
+
+def test_counter_float_values():
+    # Float deltas/reads must not silently read as 0 (num_ok=False rows).
+    h = H(
+        invoke_op(0, "add", 1),
+        ok_op(0, "add", 1),
+        invoke_op(0, "read"),
+        ok_op(0, "read", 1.0),
+    )
+    r = CounterChecker().check(None, h, {})
+    assert r["valid?"] is True
+    h2 = H(
+        invoke_op(0, "add", 0.5),
+        ok_op(0, "add", 0.5),
+        invoke_op(0, "read"),
+        ok_op(0, "read", 0.5),
+    )
+    r2 = CounterChecker().check(None, h2, {})
+    assert r2["valid?"] is True
+    assert r2["reads"] == [[0.5, 0.5, 0.5]]
+
+
+def test_unique_ids_unhashable_duplicates():
+    h = H(
+        invoke_op(0, "generate"),
+        ok_op(0, "generate", [1, 2]),
+        invoke_op(0, "generate"),
+        ok_op(0, "generate", [1, 2]),
+    )
+    r = UniqueIdsChecker().check(None, h, {})
+    assert r["valid?"] is False
+    assert r["duplicated-count"] == 1
