@@ -1,0 +1,296 @@
+"""Pure generator tests, ported from the reference's
+jepsen/test/jepsen/generator/pure_test.clj:137-375 — run through the
+zero-thread simulation harness (quick / perfect / perfect_info)."""
+
+import random
+from collections import Counter
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.generator import (
+    PENDING,
+    perfect,
+    perfect_info,
+    quick,
+)
+from jepsen_tpu.generator.simulate import default_context
+
+
+def juxt(*keys):
+    return lambda o: tuple(o.get(k) for k in keys)
+
+
+def test_nil():
+    assert perfect(None) == []
+
+
+def test_map_once():
+    assert perfect(gen.once({"f": "write"})) == [
+        {"time": 0, "process": 0, "type": "invoke", "f": "write"}
+    ]
+
+
+def test_map_concurrent():
+    # pure_test.clj:148-155 — both workers + nemesis cycle, LIFO on ties.
+    assert perfect(gen.limit(6, {"f": "write"})) == [
+        {"type": "invoke", "process": 0, "f": "write", "time": 0},
+        {"type": "invoke", "process": 1, "f": "write", "time": 0},
+        {"type": "invoke", "process": "nemesis", "f": "write", "time": 0},
+        {"type": "invoke", "process": "nemesis", "f": "write", "time": 10},
+        {"type": "invoke", "process": 1, "f": "write", "time": 10},
+        {"type": "invoke", "process": 0, "f": "write", "time": 10},
+    ]
+
+
+def test_map_all_threads_busy():
+    ctx = default_context()
+    ctx["free_threads"] = ()
+    o, g = gen.op({"f": "write"}, {}, ctx)
+    assert o == PENDING
+    assert g == {"f": "write"}
+
+
+def test_limit():
+    ops = quick(gen.limit(2, {"f": "write", "value": 1}))
+    assert ops == [
+        {"type": "invoke", "process": 0, "time": 0, "f": "write", "value": 1},
+        {"type": "invoke", "process": 0, "time": 0, "f": "write", "value": 1},
+    ]
+
+
+def test_delay_til():
+    assert perfect(gen.limit(5, gen.delay_til(3e-9, {"f": "write"}))) == [
+        {"type": "invoke", "process": 0, "time": 0, "f": "write"},
+        {"type": "invoke", "process": 1, "time": 0, "f": "write"},
+        {"type": "invoke", "process": "nemesis", "time": 0, "f": "write"},
+        {"type": "invoke", "process": 0, "time": 12, "f": "write"},
+        {"type": "invoke", "process": 1, "time": 12, "f": "write"},
+    ]
+
+
+def test_seq_vectors():
+    ops = quick(
+        [
+            gen.once({"value": 1}),
+            gen.once({"value": 2}),
+            gen.once({"value": 3}),
+        ]
+    )
+    assert [o["value"] for o in ops] == [1, 2, 3]
+
+
+def test_seq_of_maps():
+    ops = quick([gen.once({"value": v}) for v in (1, 2, 3)])
+    assert [o["value"] for o in ops] == [1, 2, 3]
+
+
+def test_fn_returning_none():
+    assert quick(lambda: None) == []
+
+
+def test_fn_returning_pairs():
+    # pure_test.clj:204-217 countdown
+    def countdown(x, test, ctx):
+        if x > 0:
+            return (
+                {
+                    "type": "invoke",
+                    "process": gen.free_processes(ctx)[0],
+                    "time": ctx["time"],
+                    "value": x,
+                },
+                lambda t, c, x=x - 1: countdown(x, t, c),
+            )
+        return None
+
+    ops = quick(lambda t, c: countdown(5, t, c))
+    assert [o["value"] for o in ops] == [5, 4, 3, 2, 1]
+
+
+def test_fn_returning_maps():
+    rng = random.Random(0)
+    ops = quick(
+        gen.limit(5, lambda: {"f": "write", "value": rng.randint(0, 10)})
+    )
+    assert len(ops) == 5
+    assert all(0 <= o["value"] <= 10 for o in ops)
+    assert len({o["value"] for o in ops}) > 1
+    assert all(o["process"] == 0 for o in ops)
+
+
+def test_synchronize():
+    # pure_test.clj:228-248
+    def delayed(test, ctx):
+        p = gen.free_processes(ctx)[0]
+        delay = {0: 2, 1: 1, "nemesis": 2}[p]
+        return {"f": "a", "process": p, "time": ctx["time"] + delay}
+
+    g = [
+        gen.limit(3, delayed),
+        gen.synchronize(gen.limit(2, {"f": "b"})),
+    ]
+    assert [juxt("f", "process", "time")(o) for o in perfect(g)] == [
+        ("a", 0, 2),
+        ("a", 1, 3),
+        ("a", "nemesis", 5),
+        ("b", 0, 15),
+        ("b", 1, 15),
+    ]
+
+
+def test_clients():
+    ops = perfect(gen.limit(5, gen.clients({})))
+    assert {o["process"] for o in ops} == {0, 1}
+
+
+def test_phases():
+    g = gen.clients(
+        gen.phases(
+            gen.limit(2, {"f": "a"}),
+            gen.limit(1, {"f": "b"}),
+            gen.limit(3, {"f": "c"}),
+        )
+    )
+    assert [juxt("f", "process", "time")(o) for o in perfect(g)] == [
+        ("a", 0, 0),
+        ("a", 1, 0),
+        ("b", 0, 10),
+        ("c", 0, 20),
+        ("c", 1, 20),
+        ("c", 1, 30),
+    ]
+
+
+def test_any():
+    g = gen.limit(
+        4,
+        gen.any_gen(
+            gen.on(lambda t: t == 0, gen.delay_til(20e-9, {"f": "a"})),
+            gen.on(lambda t: t == 1, gen.delay_til(20e-9, {"f": "b"})),
+        ),
+    )
+    assert [juxt("f", "process", "time")(o) for o in perfect(g)] == [
+        ("a", 0, 0),
+        ("b", 1, 0),
+        ("a", 0, 20),
+        ("b", 1, 20),
+    ]
+
+
+def test_each_thread():
+    g = gen.each_thread([gen.once({"f": "a"}), gen.once({"f": "b"})])
+    assert [juxt("time", "process", "f")(o) for o in perfect(g)] == [
+        (0, 0, "a"),
+        (0, 1, "a"),
+        (0, "nemesis", "a"),
+        (10, "nemesis", "b"),
+        (10, 1, "b"),
+        (10, 0, "b"),
+    ]
+
+
+def test_stagger_rate():
+    # pure_test.clj:299-327: ~n ops over ~n*dt + work/concurrency nanos.
+    n, dt = 1000, 20
+    rng = random.Random(7)
+    g = gen.stagger(
+        dt * 1e-9,
+        [gen.once({"f": "write", "value": x}) for x in range(n)],
+        rng=rng,
+    )
+    times = [o["time"] for o in perfect(g)]
+    rate = n / times[-1]
+    # Mean delay 20ns + ~10/3ns work/op => rate ~1/23. The reference
+    # asserts its empirically observed 0.035-0.040 (after admitting its
+    # own arithmetic, 0.043, disagrees — pure_test.clj:320-327); we keep
+    # the arithmetic-consistent window.
+    assert 0.035 < rate < 0.050
+
+
+def test_f_map():
+    g = gen.once(gen.f_map({"a": "b"}, {"f": "a", "value": 2}))
+    assert perfect(g) == [
+        {"type": "invoke", "process": 0, "time": 0, "f": "b", "value": 2}
+    ]
+
+
+def test_filter():
+    g = gen.gfilter(
+        lambda o: o["value"] % 2 == 0,
+        gen.limit(10, [gen.once({"value": x}) for x in range(20)]),
+    )
+    assert [o["value"] for o in perfect(g)] == [0, 2, 4, 6, 8]
+
+
+def test_log(caplog):
+    import logging
+
+    with caplog.at_level(logging.INFO, logger="jepsen_tpu.generator"):
+        g = gen.phases(
+            gen.log("first"),
+            gen.once({"f": "a"}),
+            gen.log("second"),
+            gen.once({"f": "b"}),
+        )
+        ops = perfect(g)
+    assert [o["f"] for o in ops] == ["a", "b"]
+    assert [r.message for r in caplog.records] == ["first", "second"]
+
+
+def test_mix():
+    rng = random.Random(3)
+    g = gen.mix(
+        [gen.limit(5, {"f": "a"}), gen.limit(10, {"f": "b"})], rng=rng
+    )
+    fs = [o["f"] for o in perfect(g)]
+    assert Counter(fs) == {"a": 5, "b": 10}
+    assert fs != ["a"] * 5 + ["b"] * 10  # actually interleaved
+
+
+def test_process_limit():
+    # pure_test.clj:365-375: crashes retire processes; 5 processes max.
+    g = gen.clients(
+        gen.process_limit(
+            5, [gen.once({"value": x}) for x in range(100)]
+        )
+    )
+    assert [juxt("process", "value")(o) for o in perfect_info(g)] == [
+        (0, 0),
+        (1, 1),
+        (3, 2),
+        (2, 3),
+        (4, 4),
+    ]
+
+
+def test_validate_rejects_bad_ops():
+    def bad(test, ctx):
+        return {"f": "x", "process": 99, "time": ctx["time"]}
+
+    with pytest.raises(gen.InvalidOp):
+        quick(gen.once(bad))
+
+
+def test_reserve_routes_threads():
+    # 1 thread -> writes; remaining (thread 1 + nemesis) -> reads.
+    g = gen.limit(6, gen.reserve(1, {"f": "w"}, {"f": "r"}))
+    ops = perfect(g)
+    by_f = {}
+    for o in ops:
+        by_f.setdefault(o["f"], set()).add(o["process"])
+    assert by_f["w"] == {0}
+    assert by_f["r"] == {1, "nemesis"}
+
+
+def test_reserve_default_only():
+    g = gen.limit(3, gen.reserve(2, {"f": "w"}, {"f": "r"}))
+    ops = perfect(g)
+    assert {o["process"] for o in ops if o["f"] == "w"} <= {0, 1}
+    assert {o["process"] for o in ops if o["f"] == "r"} <= {"nemesis"}
+
+
+def test_time_limit():
+    g = gen.time_limit(25e-9, {"f": "w"})
+    times = [o["time"] for o in perfect(g)]
+    assert times and all(t < 25 for t in times)
