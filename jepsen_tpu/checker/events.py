@@ -242,7 +242,15 @@ def history_to_events(
         # Only cas payloads spread [old, new] across (a, b); any other
         # value — including a 2-element list written to the register —
         # interns whole (same gating as columnar.Encoder.encode_payload).
-        if fc == F_CAS and isinstance(v, (list, tuple)) and len(v) == 2:
+        if fc == F_CAS:
+            # A cas payload must be [old, new]; anything else is outside
+            # the model (encoding b=0 would alias a legitimate value
+            # code and let the kernel "succeed" a garbage cas).
+            if not (isinstance(v, (list, tuple)) and len(v) == 2):
+                raise ValueError(
+                    f"cas payload must be a 2-element [old, new], "
+                    f"got {v!r} at history index {op.index}"
+                )
             return (fc, code(v[0]), code(v[1]))
         return (fc, code(v), 0)
 

@@ -151,15 +151,17 @@ class CounterChecker:
         # deltas) fall back to the record view so they aren't read as 0.
         vals = cols.num.astype(np.float64)
         relevant = (is_add | is_read) & ~cols.num_ok
-        exact = True
-        for p in np.nonzero(relevant)[0]:
-            v = h.ops[p].value
-            if isinstance(v, (int, float)) and not isinstance(v, bool):
-                vals[p] = v
-                exact = False
-            else:
-                vals[p] = np.nan if is_read[p] else 0.0
-        if exact:
+        # Any fallback assignment — numeric rescue OR a NaN garbage-read
+        # marker — means the float copy carries information cols.num
+        # doesn't; only revert to the int columns when untouched.
+        if relevant.any():
+            for p in np.nonzero(relevant)[0]:
+                v = h.ops[p].value
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    vals[p] = v
+                else:
+                    vals[p] = np.nan if is_read[p] else 0.0
+        else:
             vals = cols.num
 
         upper_cum = np.cumsum(np.where(is_invoke & is_add, vals, 0))
@@ -431,6 +433,16 @@ class SetFullChecker:
                         add_inv_idx.append(op.index)
                         add_ok_idx.append(-1)
                         add_ok_time.append(-1)
+                    else:
+                        # Re-add of a tracked element: the reference
+                        # overwrites with a fresh record (checker.clj
+                        # set-full assoc), so reset the row — earlier
+                        # reads become ineligible via the r_comp > a_inv
+                        # gate below.
+                        row = el_of_code[c]
+                        add_inv_idx[row] = op.index
+                        add_ok_idx[row] = -1
+                        add_ok_time[row] = -1
                 elif op.is_ok and c in el_of_code:
                     row = el_of_code[c]
                     if add_ok_idx[row] < 0:

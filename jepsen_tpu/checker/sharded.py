@@ -162,12 +162,20 @@ def check_keys(
     else:
         n_keys = n_real
     cols = stack_streams(streams, W=W, n_keys=n_keys)
-    args = tuple(jnp.asarray(c) for c in cols)
     K = k_ladder[0]
 
     if mesh is None:
+        args = tuple(jnp.asarray(c) for c in cols)
         alive, overflow = _wgl_vmap(*args, model_name=model, K=K, W=W)
     else:
+        # Place inputs on the mesh explicitly: a bare jnp.asarray lands
+        # on the default backend, which may not be the mesh's platform
+        # (e.g. a virtual CPU mesh under an ambient TPU plugin).
+        from jax.sharding import NamedSharding
+
+        spec = P(mesh.axis_names[0])
+        sharding = NamedSharding(mesh, spec)
+        args = tuple(jax.device_put(np.asarray(c), sharding) for c in cols)
         fn = make_sharded_checker(mesh, model, K, W)
         alive, overflow = fn(*args)
     alive = np.asarray(alive)[:n_real]

@@ -30,6 +30,10 @@ Notable divergences from the reference, on purpose:
   version would NPE on them).
 - `mix` and `stagger` accept an explicit random.Random for reproducible
   schedules.
+- `limit` does not decrement its budget when the child is PENDING
+  (the reference decrements unconditionally, pure.clj:634-639, so a
+  pending poll burns an op from the quota); counting only emitted ops
+  is the intended semantics here.
 """
 
 from __future__ import annotations

@@ -108,8 +108,8 @@ def simulate(
         else:
             # Must complete something first. NOTE: g2 is discarded — the
             # invocation wasn't consumed.
+            assert in_flight, "generator pending and nothing in flight"
             o = in_flight[0]
-            assert o is not None, "generator pending and nothing in flight"
             thread = gen.process_to_thread(ctx, o["process"])
             ctx = dict(ctx)
             ctx["time"] = max(ctx["time"], o["time"])
