@@ -23,8 +23,12 @@ jepsen/src/jepsen/core.clj:199-232,338-355):
   observable result neither constrains nor changes the register.
 - Slots are assigned from a free list at INVOKE and recycled at RETURN.
   The maximum concurrently-open count is the required window W; masks
-  are int32 bitsets so W must be <= 31 (the reference's own guidance
-  caps ~20 processes per key — linearizable_register.clj:44-53).
+  are *multi-word* int32 bitsets (32 slots per word), so W can exceed a
+  single int32 — up to MAX_WINDOW=128 (4 words). Crashed ops never free
+  their slot, so long tests with steady :info ops push the window well
+  past the reference's ~20-processes-per-key guidance
+  (linearizable_register.clj:44-53); the multi-word masks are what keep
+  such histories on the accelerator.
 """
 
 from __future__ import annotations
@@ -43,7 +47,21 @@ EV_INVOKE, EV_RETURN, EV_NOP = 0, 1, 2
 
 NIL = -1
 
-MAX_WINDOW = 31
+MAX_WINDOW = 128
+
+
+def n_words(W: int) -> int:
+    """Mask words needed for a W-slot window (32 slots per int32)."""
+    return max((W + 31) // 32, 1)
+
+
+def slot_bit_table(W: int) -> np.ndarray:
+    """[W, n_words] int32: the mask word pattern for each slot's bit."""
+    nw = n_words(W)
+    out = np.zeros((W, nw), np.uint32)
+    for w in range(W):
+        out[w, w // 32] = np.uint32(1) << np.uint32(w % 32)
+    return out.view(np.int32)
 
 
 class WindowOverflow(Exception):
@@ -114,11 +132,22 @@ class ReturnSteps:
     b: np.ndarray  # [n, W] int32
     slot: np.ndarray  # [n] int32 — the returning slot
     live: np.ndarray  # [n] bool — False rows are padding
+    #: [n, n_words(W)] int32 — mask of slots whose current occupant never
+    #: returns (crashed :info ops). Monotone over steps; drives the
+    #: kernel's dominance pruning.
+    crashed: np.ndarray
+    #: [n] int32 — history op index of the returning completion, for
+    #: failure artifacts (-1 on padding rows).
+    op_index: np.ndarray
     init_state: int
     W: int
 
     def __len__(self) -> int:
         return int(self.slot.shape[0])
+
+    @property
+    def NW(self) -> int:
+        return int(self.crashed.shape[1]) if len(self) else n_words(self.W)
 
     def padded(self, n: int) -> "ReturnSteps":
         cur = len(self)
@@ -127,6 +156,7 @@ class ReturnSteps:
         if n == cur:
             return self
         pad = n - cur
+        nw = n_words(self.W)
         return ReturnSteps(
             occ=np.concatenate([self.occ, np.zeros((pad, self.W), bool)]),
             f=np.concatenate([self.f, np.zeros((pad, self.W), np.int32)]),
@@ -134,25 +164,53 @@ class ReturnSteps:
             b=np.concatenate([self.b, np.zeros((pad, self.W), np.int32)]),
             slot=np.concatenate([self.slot, np.zeros(pad, np.int32)]),
             live=np.concatenate([self.live, np.zeros(pad, bool)]),
+            crashed=np.concatenate(
+                [self.crashed, np.zeros((pad, nw), np.int32)]
+            ),
+            op_index=np.concatenate(
+                [self.op_index, np.full(pad, -1, np.int32)]
+            ),
             init_state=self.init_state,
             W=self.W,
         )
+
+
+def crashed_invokes(events: EventStream) -> np.ndarray:
+    """[n_events] bool — True at INVOKE events whose op never returns."""
+    out = np.zeros(len(events), bool)
+    open_inv: Dict[int, int] = {}
+    for i in range(len(events)):
+        kind = int(events.kind[i])
+        s = int(events.slot[i])
+        if kind == EV_INVOKE:
+            open_inv[s] = i
+            out[i] = True  # assume crashed until a RETURN proves otherwise
+        elif kind == EV_RETURN:
+            out[open_inv.pop(s)] = False
+    return out
 
 
 def events_to_steps(events: EventStream, W: int) -> ReturnSteps:
     """Precompile an event stream into per-return window snapshots."""
     if events.window > W:
         raise ValueError(f"window {events.window} exceeds W={W}")
+    nw = n_words(W)
+    crashed_inv = crashed_invokes(events)
     n_ret = int(np.sum(events.kind == EV_RETURN))
     occ = np.zeros(W, bool)
     sf = np.zeros(W, np.int32)
     sa = np.zeros(W, np.int32)
     sb = np.zeros(W, np.int32)
+    crash = np.zeros(nw, np.int32)
     out_occ = np.zeros((n_ret, W), bool)
     out_f = np.zeros((n_ret, W), np.int32)
     out_a = np.zeros((n_ret, W), np.int32)
     out_b = np.zeros((n_ret, W), np.int32)
     out_slot = np.zeros(n_ret, np.int32)
+    out_crash = np.zeros((n_ret, nw), np.int32)
+    out_opidx = np.full(n_ret, -1, np.int32)
+    has_opidx = events.op_index is not None
+    bits = slot_bit_table(W)
     j = 0
     for i in range(len(events)):
         kind = int(events.kind[i])
@@ -162,12 +220,17 @@ def events_to_steps(events: EventStream, W: int) -> ReturnSteps:
             sf[s] = events.f[i]
             sa[s] = events.a[i]
             sb[s] = events.b[i]
+            if crashed_inv[i]:
+                crash |= bits[s]
         elif kind == EV_RETURN:
             out_occ[j] = occ
             out_f[j] = sf
             out_a[j] = sa
             out_b[j] = sb
             out_slot[j] = s
+            out_crash[j] = crash
+            if has_opidx:
+                out_opidx[j] = events.op_index[i]
             j += 1
             occ[s] = False
     return ReturnSteps(
@@ -177,6 +240,8 @@ def events_to_steps(events: EventStream, W: int) -> ReturnSteps:
         b=out_b,
         slot=out_slot,
         live=np.ones(n_ret, bool),
+        crashed=out_crash,
+        op_index=out_opidx,
         init_state=events.init_state,
         W=W,
     )

@@ -61,10 +61,12 @@ def cas_register_step_py(state: int, f: int, a: int, b: int) -> Tuple[bool, int]
 
 
 def cas_register_step_jax(state, f, a, b):
+    # Pure boolean algebra + where on ints only: keeps the function
+    # Mosaic-lowerable inside the Pallas megakernel as well as jittable.
     is_read = f == F_READ
     is_write = f == F_WRITE
     is_cas = f == F_CAS
-    ok = jnp.where(is_write, True, (state == a) & (is_read | is_cas))
+    ok = is_write | ((state == a) & (is_read | is_cas))
     state2 = jnp.where(is_write, a, jnp.where(is_cas, b, state))
     return ok, state2
 

@@ -68,6 +68,8 @@ def stack_streams(
                 b=np.zeros_like(blank.b),
                 slot=np.zeros_like(blank.slot),
                 live=np.zeros_like(blank.live),
+                crashed=np.zeros_like(blank.crashed),
+                op_index=np.full_like(blank.op_index, -1),
                 init_state=-1,
                 W=W,
             )
@@ -78,18 +80,26 @@ def stack_streams(
     b = np.stack([st.b for st in steps])
     slot = np.stack([st.slot for st in steps])
     live = np.stack([st.live for st in steps])
+    crashed = np.stack([st.crashed for st in steps])
+    op_index = np.stack([st.op_index for st in steps])
     init_state = np.asarray([st.init_state for st in steps], np.int32)
-    return occ, f, a, b, slot, live, init_state
+    return occ, f, a, b, slot, live, crashed, op_index, init_state
 
 
-def _vmap_scan(occ, f, a, b, slot, live, init_state, model_name, K, W):
+#: number of stacked per-key arrays fed to the kernel
+N_COLS = 9
+
+
+def _vmap_scan(
+    occ, f, a, b, slot, live, crashed, op_index, init_state, model_name, K, W
+):
     """Unjitted key-axis batch of the frontier scan — the shared body of
     both the single-device vmap path and the shard_map per-shard path."""
     return jax.vmap(
-        lambda o, ff, aa, bb, s, l, i: wgl_scan_steps(
-            o, ff, aa, bb, s, l, i, model_name, K, W
+        lambda o, ff, aa, bb, s, l, c, oi, i: wgl_scan_steps(
+            o, ff, aa, bb, s, l, c, oi, i, model_name, K, W
         )
-    )(occ, f, a, b, slot, live, init_state)
+    )(occ, f, a, b, slot, live, crashed, op_index, init_state)
 
 
 _wgl_vmap = functools.partial(
@@ -104,9 +114,10 @@ def make_sharded_checker(mesh: Mesh, model_name: str, K: int, W: int):
     axis = mesh.axis_names[0]
     spec = P(axis)
 
-    def per_shard(occ, f, a, b, slot, live, init_state):
+    def per_shard(occ, f, a, b, slot, live, crashed, op_index, init_state):
         return _vmap_scan(
-            occ, f, a, b, slot, live, init_state, model_name, K, W
+            occ, f, a, b, slot, live, crashed, op_index, init_state,
+            model_name, K, W,
         )
 
     # check_vma (née check_rep) statically verifies collective usage; the
@@ -117,16 +128,16 @@ def make_sharded_checker(mesh: Mesh, model_name: str, K: int, W: int):
         sharded = _shard_map(
             per_shard,
             mesh=mesh,
-            in_specs=(spec,) * 7,
-            out_specs=(spec, spec),
+            in_specs=(spec,) * N_COLS,
+            out_specs=(spec, spec, spec),
             check_vma=False,
         )
     except TypeError:  # pragma: no cover - older JAX
         sharded = _shard_map(
             per_shard,
             mesh=mesh,
-            in_specs=(spec,) * 7,
-            out_specs=(spec, spec),
+            in_specs=(spec,) * N_COLS,
+            out_specs=(spec, spec, spec),
             check_rep=False,
         )
     return jax.jit(sharded)
@@ -166,7 +177,7 @@ def check_keys(
 
     if mesh is None:
         args = tuple(jnp.asarray(c) for c in cols)
-        alive, overflow = _wgl_vmap(*args, model_name=model, K=K, W=W)
+        alive, overflow, died = _wgl_vmap(*args, model_name=model, K=K, W=W)
     else:
         # Place inputs on the mesh explicitly: a bare jnp.asarray lands
         # on the default backend, which may not be the mesh's platform
@@ -177,22 +188,24 @@ def check_keys(
         sharding = NamedSharding(mesh, spec)
         args = tuple(jax.device_put(np.asarray(c), sharding) for c in cols)
         fn = make_sharded_checker(mesh, model, K, W)
-        alive, overflow = fn(*args)
+        alive, overflow, died = fn(*args)
     alive = np.asarray(alive)[:n_real]
     overflow = np.asarray(overflow)[:n_real]
+    died = np.asarray(died)[:n_real]
 
     method = "tpu-wgl-sharded" if mesh is not None else "tpu-wgl-batch"
     out: List[dict] = []
     for i, s in enumerate(streams):
         if alive[i] or not overflow[i]:
-            out.append(
-                {
-                    "valid?": bool(alive[i]),
-                    "method": method,
-                    "frontier_k": K,
-                    "escalations": 0,
-                }
-            )
+            r = {
+                "valid?": bool(alive[i]),
+                "method": method,
+                "frontier_k": K,
+                "escalations": 0,
+            }
+            if not alive[i]:
+                r["failed_op_index"] = int(died[i])
+            out.append(r)
         else:
             # Overflow-tainted False: escalate this key alone.
             r = check_events_bucketed(

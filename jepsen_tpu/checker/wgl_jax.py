@@ -6,31 +6,51 @@ project.clj:13); design per SURVEY.md §7.2.
 Formulation (just-in-time linearization, tensorized):
 
 - A *configuration* is (state, mask): the register's interned value code
-  and an int32 bitset of which currently-open ops have linearized.
+  and a multi-word int32 bitset ([NW] words, 32 slots each) of which
+  currently-open ops have linearized. Multi-word masks lift the window
+  limit to events.MAX_WINDOW=128 slots — crashed ops never free their
+  slot, so long histories with steady :info ops need the headroom.
 - The frontier is a fixed-size padded buffer of K configurations with a
   validity mask — no hash tables; set semantics come from lexicographic
   sort + neighbor-compare dedup + stable compaction (all TPU-friendly
   primitives).
 - Only RETURN events mutate the frontier, so the host precompiles the
   event stream into *return steps* (events.events_to_steps): per return,
-  a snapshot of the open-op window (occ/f/a/b, each [W]) and the
-  returning slot. One `lax.scan` consumes [n_steps, ...] arrays with a
-  frontier-only carry — INVOKE bookkeeping never touches the device and
-  costs zero scan iterations.
+  a snapshot of the open-op window (occ/f/a/b, each [W]), the returning
+  slot, and the crashed-slot mask. One `lax.scan` consumes
+  [n_steps, ...] arrays with a frontier-only carry — INVOKE bookkeeping
+  never touches the device and costs zero scan iterations.
 - Each step runs the closure (a `lax.while_loop` of vectorized
-  expand→dedup rounds: every open op tried against every configuration
-  at once, a [K, W] broadcast of the model step), then filters to
-  configurations with the returning op linearized and clears its bit.
-- Closure convergence: the within-step frontier grows monotonically
-  (originals are always kept), so `count == prev_count` is a fixpoint;
-  the loop is also bounded by W+1 rounds.
+  expand→dedup→prune rounds: every open op tried against every
+  configuration at once, a [K, W] broadcast of the model step), then
+  filters to configurations with the returning op linearized and clears
+  its bit. Clearing cannot merge configurations — every survivor has
+  the bit set, so no two of them differ only in it — hence no re-dedup
+  after the filter.
+- *Dominance pruning* (exactness-preserving): config (s, m) dominates
+  (s, m') when their live bits agree and m's crashed bits are a subset
+  of m''s — the dominator can replay any future of the dominated config
+  (filters only ever test live bits, because crashed ops never return).
+  Pruning collapses the 2^crashed-ops frontier blowup, keeping K small
+  on crash-heavy histories; it is the kernel analog of the oracle's
+  antichain prune (wgl_oracle._prune).
+- Closure convergence: rounds repeat until the frontier arrays reach a
+  fixpoint (every round is a deterministic function of the config set,
+  so set-stability implies array-stability), bounded by W+4 rounds; an
+  unconverged exit taints the verdict like an overflow.
 
 Soundness under overflow: a surviving configuration is a *witness* — it
 descends from a chain of legal linearizations that passed every RETURN
 filter — so alive=True is trustworthy even if the frontier buffer
-overflowed (drops lose witnesses, never create them). alive=False with
-overflow is "unknown": the driver escalates K (shape-bucketed recompile)
-and finally falls back to the unbounded CPU oracle.
+overflowed (drops lose witnesses, never create them; pruning drops only
+dominated configs, which never changes the verdict at all). alive=False
+with overflow is "unknown": the driver escalates K (shape-bucketed
+recompile) and finally falls back to the unbounded CPU oracle.
+
+Failure artifacts: the scan carries the history op index of the first
+RETURN whose filter emptied the frontier (died_op_index, -1 if alive) —
+the analog of the reference's failing-op reporting
+(jepsen/src/jepsen/checker.clj:146-154).
 """
 
 from __future__ import annotations
@@ -43,108 +63,169 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from jepsen_tpu.checker.events import EventStream, ReturnSteps, events_to_steps
+from jepsen_tpu.checker.events import (
+    EventStream,
+    ReturnSteps,
+    events_to_steps,
+    n_words,
+    slot_bit_table,
+)
 from jepsen_tpu.checker.models import model as get_model
 
 SENTINEL = jnp.int32(2**31 - 1)
 
 
-def _dedup_compact(s, m, v):
-    """Deduplicate (s, m) rows and compact valid rows to the front.
+def _canonicalize(s, m, v, crashed, K):
+    """One fused set-canonicalization pass over [N] candidate rows:
 
-    Returns (s', m', v') of the same length: valid rows are the unique
-    configurations, sorted, followed by sentinel padding.
+    - exact-duplicate kill (lowest row index wins, via a 2-D iota
+      tiebreak — no lexicographic sort, so no sentinel plumbing);
+    - dominance kill (see module docstring) against the step's [NW]
+      crashed mask;
+    - compaction of survivors to the front via ONE stable sort on the
+      validity key (insertion order is deterministic, so the compacted
+      array is a deterministic function of the config set — which is
+      what makes the closure's array-fixpoint test sound);
+    - overflow = any survivor past row K (measured post-prune, so the
+      escalation ladder reacts to the *pruned* frontier size, not the
+      raw closure blowup).
+
+    Returns (s[:K], m[:K], v[:K], overflow).
     """
-    s = jnp.where(v, s, SENTINEL)
-    m = jnp.where(v, m, SENTINEL)
-    s, m = lax.sort((s, m), num_keys=2)
-    dup = (s == jnp.roll(s, 1)) & (m == jnp.roll(m, 1))
-    dup = dup.at[0].set(False)
-    valid = (s != SENTINEL) & ~dup
-    key = (~valid).astype(jnp.int32)
-    key, s, m = lax.sort((key, s, m), num_keys=1, is_stable=True)
-    return s, m, key == 0
+    N = s.shape[0]
+    NW = m.shape[1]
+    eq = s[:, None] == s[None, :]
+    meq = jnp.all(m[:, None, :] == m[None, :, :], axis=-1)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    earlier = idx[:, None] < idx[None, :]
+    dup = eq & meq & earlier
+
+    live = m & ~crashed[None, :]
+    cra = m & crashed[None, :]
+    live_eq = jnp.all(live[:, None, :] == live[None, :, :], axis=-1)
+    cra_sub = jnp.all(
+        (cra[:, None, :] & cra[None, :, :]) == cra[:, None, :], axis=-1
+    )
+    dom = eq & live_eq & cra_sub & ~meq
+
+    kill = jnp.any(v[:, None] & v[None, :] & (dup | dom), axis=0)
+    v = v & ~kill
+
+    key = (~v).astype(jnp.int32)
+    out = lax.sort(
+        (key, s) + tuple(m[:, i] for i in range(NW)),
+        num_keys=1,
+        is_stable=True,
+    )
+    key, s, mcols = out[0], out[1], out[2:]
+    m = jnp.stack(mcols, axis=1)
+    v = key == 0
+    overflow = jnp.any(v[K:])
+    return s[:K], m[:K], v[:K], overflow
 
 
-def _make_step(model_name: str, K: int, W: int):
-    """Build the scan step for static (model, K, W). The step consumes
-    one return-step: (occ[W], f[W], a[W], b[W], slot, live)."""
+def _make_step(model_name: str, K: int, W: int, NW: int):
+    """Build the scan step for static (model, K, W, NW). The step
+    consumes one return-step: (occ[W], f[W], a[W], b[W], slot, live,
+    crashed[NW], op_index)."""
     step_jax = get_model(model_name).step_jax
-    slot_bits = jnp.left_shift(jnp.int32(1), jnp.arange(W, dtype=jnp.int32))
+    bitw = jnp.asarray(slot_bit_table(W))  # [W, NW]
 
-    def closure_round(fs, fm, fv, occ, sf, sa, sb):
+    def closure_round(fs, fm, fv, occ, sf, sa, sb, crashed):
         # Expand: linearize every open, unlinearized op against every
-        # configuration — [K, W] broadcast of the model step.
-        lin = (fm[:, None] & slot_bits[None, :]) != 0
+        # configuration — a [K, W] broadcast of the model step.
+        lin = jnp.any((fm[:, None, :] & bitw[None, :, :]) != 0, axis=-1)
         elig = fv[:, None] & occ[None, :] & ~lin
         ok, s2 = step_jax(fs[:, None], sf[None, :], sa[None, :], sb[None, :])
         cand_v = (elig & ok).reshape(-1)
         cand_s = s2.reshape(-1)
-        cand_m = (fm[:, None] | slot_bits[None, :]).reshape(-1)
+        cand_m = (fm[:, None, :] | bitw[None, :, :]).reshape(-1, NW)
         all_s = jnp.concatenate([fs, cand_s])
-        all_m = jnp.concatenate([fm, cand_m])
+        all_m = jnp.concatenate([fm, cand_m], axis=0)
         all_v = jnp.concatenate([fv, cand_v])
-        all_s, all_m, all_v = _dedup_compact(all_s, all_m, all_v)
-        overflow = jnp.any(all_v[K:])
-        return all_s[:K], all_m[:K], all_v[:K], overflow
+        return _canonicalize(all_s, all_m, all_v, crashed, K)
 
-    def closure(fs, fm, fv, occ, sf, sa, sb):
+    def closure(fs, fm, fv, occ, sf, sa, sb, crashed):
         def cond(st):
-            _, _, _, cnt, prev, _, i = st
-            return (cnt > prev) & (i <= W)
+            _, _, _, changed, _, i = st
+            return changed & (i <= W + 4)
 
         def body(st):
-            fs, fm, fv, cnt, _, ovf, i = st
-            fs, fm, fv, ovf2 = closure_round(fs, fm, fv, occ, sf, sa, sb)
-            return (fs, fm, fv, fv.sum(), cnt, ovf | ovf2, i + 1)
+            fs, fm, fv, _, ovf, i = st
+            nfs, nfm, nfv, ovf2 = closure_round(
+                fs, fm, fv, occ, sf, sa, sb, crashed
+            )
+            changed = (
+                jnp.any(nfs != fs) | jnp.any(nfm != fm) | jnp.any(nfv != fv)
+            )
+            return (nfs, nfm, nfv, changed, ovf | ovf2, i + 1)
 
         # Scalars derive from fv (not fresh constants) so they carry the
         # same varying-axes type as the data under shard_map.
-        cnt0 = fv.sum()
-        init = (fs, fm, fv, cnt0, jnp.full_like(cnt0, -1), jnp.any(fv) & False, 0)
-        fs, fm, fv, _, _, ovf, _ = lax.while_loop(cond, body, init)
-        return fs, fm, fv, ovf
+        t = jnp.any(fv) | True
+        init = (fs, fm, fv, t, ~t, jnp.int32(0))
+        fs, fm, fv, changed, ovf, _ = lax.while_loop(cond, body, init)
+        # Exited still-changing (round bound hit): unconverged — taint
+        # the verdict exactly like a capacity overflow.
+        return fs, fm, fv, ovf | changed
 
     def step(carry, xs):
-        fs, fm, fv, alive, ovf = carry
-        occ, sf, sa, sb, slot, live = xs
+        fs, fm, fv, alive, ovf, died = carry
+        occ, sf, sa, sb, slot, live, crashed, opidx = xs
 
         def work(_):
-            cfs, cfm, cfv, covf = closure(fs, fm, fv, occ, sf, sa, sb)
-            bit = jnp.left_shift(jnp.int32(1), slot)
-            cfv = cfv & ((cfm & bit) != 0)
-            cfm = cfm & ~bit
-            # Clearing the bit can merge configs; re-dedup so duplicate
-            # rows don't eat frontier capacity.
-            return _dedup_compact(cfs, cfm, cfv) + (covf,)
+            cfs, cfm, cfv, covf = closure(
+                fs, fm, fv, occ, sf, sa, sb, crashed
+            )
+            wi = slot // 32
+            bitword = jnp.left_shift(
+                (jnp.arange(NW, dtype=jnp.int32) == wi).astype(jnp.int32),
+                slot % 32,
+            )
+            has = jnp.any((cfm & bitword[None, :]) != 0, axis=-1)
+            # Filter to configs with the returning op linearized, then
+            # clear its bit (no merge possible — see module docstring).
+            return cfs, cfm & ~bitword[None, :], cfv & has, covf
 
         def skip(_):
             return fs, fm, fv, live & False
 
         fs2, fm2, fv2, covf = lax.cond(alive & live, work, skip, None)
-        alive2 = alive & (jnp.any(fv2) | ~live)
-        return (fs2, fm2, fv2, alive2, ovf | covf), None
+        any_live = jnp.any(fv2)
+        now_dead = alive & live & ~any_live
+        died2 = jnp.where(now_dead & (died < 0), opidx, died)
+        alive2 = alive & (any_live | ~live)
+        return (fs2, fm2, fv2, alive2, ovf | covf, died2), None
 
     return step
 
 
-def wgl_scan_steps(occ, sf, sa, sb, slot, live, init_state, model_name, K, W):
-    """Unjitted scan over precompiled return steps -> (alive, overflow).
-    Pure JAX: safe to jit, vmap (batch over keys), or shard_map directly.
+def wgl_scan_steps(
+    occ, sf, sa, sb, slot, live, crashed, opidx, init_state, model_name, K, W
+):
+    """Unjitted scan over precompiled return steps ->
+    (alive, overflow, died_op_index). Pure JAX: safe to jit, vmap (batch
+    over keys), or shard_map directly.
 
-    occ/sf/sa/sb: [n, W]; slot/live: [n]; live=False rows are padding.
+    occ/sf/sa/sb: [n, W]; slot/live/opidx: [n]; crashed: [n, NW];
+    live=False rows are padding.
     """
-    step = _make_step(model_name, K, W)
+    NW = crashed.shape[-1]
+    step = _make_step(model_name, K, W, NW)
     # All carry values derive from init_state (an input) so they inherit
     # its varying-axes type under shard_map; fresh constants would trip
     # the manual-axes consistency check.
     fs = jnp.full((K,), SENTINEL, jnp.int32).at[0].set(init_state)
-    fm = jnp.zeros((K,), jnp.int32) + (init_state & 0)
+    fm = jnp.zeros((K, NW), jnp.int32) + (init_state & 0)[None, None]
     fv = jnp.zeros((K,), bool).at[0].set(init_state == init_state)
-    carry = (fs, fm, fv, init_state == init_state, init_state != init_state)
-    carry, _ = lax.scan(step, carry, (occ, sf, sa, sb, slot, live))
-    _, _, _, alive, overflow = carry
-    return alive, overflow
+    alive = init_state == init_state
+    died = jnp.int32(-1) + (init_state & 0)
+    carry = (fs, fm, fv, alive, ~alive, died)
+    carry, _ = lax.scan(
+        step, carry, (occ, sf, sa, sb, slot, live, crashed, opidx)
+    )
+    _, _, _, alive, overflow, died = carry
+    return alive, overflow, died
 
 
 _wgl_scan_steps = functools.partial(
@@ -152,23 +233,33 @@ _wgl_scan_steps = functools.partial(
 )(wgl_scan_steps)
 
 
-def check_steps_jax(
-    steps: ReturnSteps, model: str = "cas-register", K: int = 64
-) -> Tuple[bool, bool]:
-    """Run the kernel over precompiled return steps: (alive, overflow)."""
-    alive, overflow = _wgl_scan_steps(
+def steps_device_args(steps: ReturnSteps) -> tuple:
+    """The positional device arrays for wgl_scan_steps, in order."""
+    return (
         jnp.asarray(steps.occ),
         jnp.asarray(steps.f),
         jnp.asarray(steps.a),
         jnp.asarray(steps.b),
         jnp.asarray(steps.slot),
         jnp.asarray(steps.live),
+        jnp.asarray(steps.crashed),
+        jnp.asarray(steps.op_index),
+    )
+
+
+def check_steps_jax(
+    steps: ReturnSteps, model: str = "cas-register", K: int = 64
+) -> Tuple[bool, bool, int]:
+    """Run the kernel over precompiled return steps:
+    (alive, overflow, died_op_index)."""
+    alive, overflow, died = _wgl_scan_steps(
+        *steps_device_args(steps),
         jnp.int32(steps.init_state),
         model_name=model if isinstance(model, str) else model.name,
         K=K,
         W=steps.W,
     )
-    return bool(alive), bool(overflow)
+    return bool(alive), bool(overflow), int(died)
 
 
 def check_events_jax(
@@ -186,4 +277,5 @@ def check_events_jax(
     if events.window > W:
         raise ValueError(f"window {events.window} exceeds kernel W={W}")
     steps = events_to_steps(events, W=W)
-    return check_steps_jax(steps, model=model, K=K)
+    alive, overflow, _ = check_steps_jax(steps, model=model, K=K)
+    return alive, overflow

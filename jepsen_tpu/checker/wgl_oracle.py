@@ -28,25 +28,68 @@ from jepsen_tpu.checker.events import EV_INVOKE, EV_NOP, EV_RETURN, EventStream
 from jepsen_tpu.checker.models import Model, model as get_model
 
 
+def _prune(
+    frontier: Set[Tuple[int, int]], crashed_mask: int
+) -> Set[Tuple[int, int]]:
+    """Crashed-bit dominance pruning (exactness-preserving).
+
+    Config (s, m) *dominates* (s, m') when their live bits agree and m's
+    crashed bits are a strict subset of m''s: the dominator can replay
+    any future of the dominated config (more crashed ops still
+    available; filters only ever test live bits, because crashed ops
+    never return). Dropping dominated configs loses no witnesses, and
+    collapses the 2^crashed-ops frontier blowup that long histories with
+    steady :info ops otherwise suffer.
+    """
+    if not crashed_mask or len(frontier) < 2:
+        return frontier
+    groups: dict = {}
+    for st, mk in frontier:
+        groups.setdefault((st, mk & ~crashed_mask), []).append(
+            mk & crashed_mask
+        )
+    out: Set[Tuple[int, int]] = set()
+    for (st, live), cbs in groups.items():
+        cbs.sort(key=lambda x: bin(x).count("1"))
+        kept: List[int] = []
+        for cb in cbs:
+            if not any(k & cb == k for k in kept):
+                kept.append(cb)
+        for cb in kept:
+            out.add((st, live | cb))
+    return out
+
+
 def _closure(
     frontier: Set[Tuple[int, int]],
     open_ops: dict,
     step_py,
+    crashed_mask: int = 0,
+    prune: bool = True,
 ) -> Set[Tuple[int, int]]:
-    """All configurations reachable by linearizing open ops, in any order."""
+    """All configurations reachable by linearizing open ops, in any
+    order, expanded in BFS layers with dominance pruning per layer (so
+    intermediate sets stay near the pruned fixpoint instead of the full
+    2^crashed closure)."""
     seen = set(frontier)
-    work = list(frontier)
-    while work:
-        state, mask = work.pop()
-        for s, (f, a, b) in open_ops.items():
-            if (mask >> s) & 1:
-                continue
-            ok, state2 = step_py(state, f, a, b)
-            if ok:
-                cfg = (state2, mask | (1 << s))
-                if cfg not in seen:
-                    seen.add(cfg)
-                    work.append(cfg)
+    layer = list(frontier)
+    while layer:
+        nxt = []
+        for state, mask in layer:
+            for s, (f, a, b) in open_ops.items():
+                if (mask >> s) & 1:
+                    continue
+                ok, state2 = step_py(state, f, a, b)
+                if ok:
+                    cfg = (state2, mask | (1 << s))
+                    if cfg not in seen:
+                        seen.add(cfg)
+                        nxt.append(cfg)
+        if prune and nxt and crashed_mask:
+            pruned = _prune(seen, crashed_mask)
+            nxt = [c for c in nxt if c in pruned]
+            seen = pruned
+        layer = nxt
     return seen
 
 
@@ -54,17 +97,24 @@ def check_events(
     events: EventStream,
     model: Any = "cas-register",
     return_stats: bool = False,
+    prune: bool = True,
 ):
     """Frontier-search linearizability verdict over an event stream.
 
-    Returns bool, or (bool, stats) with max frontier size when
-    return_stats is set.
+    Returns bool, or (bool, stats) when return_stats is set; stats
+    carries max frontier size, the failing event position, and the
+    failing op's history index (when the stream has op_index).
     """
     m: Model = get_model(model)
     step = m.step_py
     frontier: Set[Tuple[int, int]] = {(events.init_state, 0)}
     open_ops: dict = {}
     max_frontier = 1
+    crashed_mask = 0
+    if prune:
+        from jepsen_tpu.checker.events import crashed_invokes
+
+        crashed_inv = crashed_invokes(events)
 
     for i in range(len(events)):
         kind = int(events.kind[i])
@@ -73,8 +123,12 @@ def check_events(
         s = int(events.slot[i])
         if kind == EV_INVOKE:
             open_ops[s] = (int(events.f[i]), int(events.a[i]), int(events.b[i]))
+            if prune and crashed_inv[i]:
+                crashed_mask |= 1 << s
         else:  # EV_RETURN of the op in slot s
-            frontier = _closure(frontier, open_ops, step)
+            frontier = _closure(
+                frontier, open_ops, step, crashed_mask, prune=prune
+            )
             max_frontier = max(max_frontier, len(frontier))
             frontier = {
                 (state, mask & ~(1 << s))
@@ -84,10 +138,23 @@ def check_events(
             del open_ops[s]
             if not frontier:
                 if return_stats:
-                    return False, {"max_frontier": max_frontier, "failed_at": i}
+                    op_idx = (
+                        int(events.op_index[i])
+                        if events.op_index is not None
+                        else None
+                    )
+                    return False, {
+                        "max_frontier": max_frontier,
+                        "failed_at": i,
+                        "failed_op_index": op_idx,
+                    }
                 return False
     if return_stats:
-        return True, {"max_frontier": max_frontier, "failed_at": None}
+        return True, {
+            "max_frontier": max_frontier,
+            "failed_at": None,
+            "failed_op_index": None,
+        }
     return True
 
 

@@ -1,0 +1,31 @@
+"""Runtime: orchestrator, workers, and the client protocol.
+
+The host-side core that produces histories for the TPU analysis plane.
+Reference: jepsen/src/jepsen/core.clj, client.clj.
+"""
+
+from jepsen_tpu.runtime.client import (
+    AtomClient,
+    AtomRegister,
+    Client,
+    ClientFailed,
+    noop,
+)
+from jepsen_tpu.runtime.core import (
+    ClientWorker,
+    NemesisWorker,
+    Scheduler,
+    run,
+)
+
+__all__ = [
+    "AtomClient",
+    "AtomRegister",
+    "Client",
+    "ClientFailed",
+    "ClientWorker",
+    "NemesisWorker",
+    "Scheduler",
+    "noop",
+    "run",
+]

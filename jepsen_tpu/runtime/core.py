@@ -1,0 +1,408 @@
+"""Core runtime: the test orchestrator and worker loops.
+
+The accelerator-era analog of the reference's core runtime
+(jepsen/src/jepsen/core.clj): `run()` takes a declarative test spec,
+spawns one OS thread per logical worker plus a nemesis worker, drives
+them from a SHARED pure generator (the v2 design the reference was
+migrating toward — generator/pure.clj — which this framework adopts
+outright), records a concurrent history, and hands it to the checker
+(the TPU analysis plane).
+
+Faithfully reproduced semantics:
+- Worker loop (core.clj:299-358): poll generator -> stamp
+  process/relative-time -> (re)open client if needed -> journal invoke
+  -> client.invoke -> journal completion.
+- Exception conversion (core.clj:199-232): client exceptions become
+  :info completions (indeterminate) with the error recorded;
+  ClientFailed becomes :fail (definitely didn't happen).
+- Crash cycling (core.clj:338-355): an :info completion retires the
+  logical process — the thread closes its client and adopts process
+  `p + (count of numeric processes)`, keeping per-process history
+  single-threaded, which the linearizability checker's soundness
+  depends on.
+- Failed client open (core.clj:313-328): journals a synthetic
+  :fail invoke/completion pair with the error, then retries on the
+  next op.
+- Generator failure recovery (test/jepsen/core_test.clj:130-152): a
+  generator exception poisons the scheduler, unblocks every worker,
+  closes all clients, and rethrows from run().
+- Nemesis worker (core.clj:370-401): same loop on the "nemesis"
+  thread/process, but ops route to the test's nemesis and errors are
+  journaled, never retried.
+
+The scheduler is the real-time interpreter of the pure-generator
+contract proven by generator/simulate.py: identical context/update
+semantics, with actual clocks and threads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.generator import pure as gen
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.ops import Op
+from jepsen_tpu.runtime.client import Client, ClientFailed
+
+NEMESIS = gen.NEMESIS
+
+#: how long a worker sleeps when the generator is PENDING
+_PENDING_SLEEP = 0.0005
+#: max single sleep while waiting for a scheduled op time (keeps
+#: workers responsive to poisoning)
+_MAX_SLEEP = 0.05
+
+
+class Scheduler:
+    """Shared pure-generator state: one lock, one generator value, one
+    evolving context. Workers poll ops and report events; the scheduler
+    maintains free-thread bookkeeping and process retirement exactly as
+    generator/simulate.py does deterministically."""
+
+    def __init__(self, generator, test, threads: List[Any], t0_ns: int):
+        self._lock = threading.Lock()
+        self._gen = gen.validate(generator)
+        self._test = test
+        self._t0 = t0_ns
+        self._ctx = gen.context(
+            time=0,
+            free_threads=tuple(threads),
+            workers={t: t for t in threads},
+        )
+        self._poison: Optional[BaseException] = None
+
+    def now(self) -> int:
+        return _time.monotonic_ns() - self._t0
+
+    def poison(self, err: BaseException) -> None:
+        with self._lock:
+            if self._poison is None:
+                self._poison = err
+
+    @property
+    def poisoned(self) -> Optional[BaseException]:
+        return self._poison
+
+    def next_op(self, thread) -> Optional[dict]:
+        """Block until the generator yields an op for some free thread
+        that this thread can take, the generator is exhausted (None), or
+        the scheduler is poisoned (None). Returns the invocation as a
+        plain dict (type/f/value/process/time)."""
+        while True:
+            committed = None
+            with self._lock:
+                if self._poison is not None:
+                    return None
+                self._ctx["time"] = self.now()
+                try:
+                    pair = gen.op(self._gen, self._test, self._ctx)
+                except BaseException as e:  # generator bug: poison all
+                    self._poison = e
+                    return None
+                if pair is None:
+                    return None
+                o, g2 = pair
+                if o is not gen.PENDING:
+                    # Is this op for us? Ops carry a process; map it to
+                    # its thread. Workers only execute their own ops —
+                    # another thread's op stays uncommitted for its
+                    # owner to pick up.
+                    t = gen.process_to_thread(self._ctx, o["process"])
+                    if t == thread:
+                        # Commit NOW, even when the op is scheduled in
+                        # the future, then sleep until its time outside
+                        # the lock. Re-polling later instead would
+                        # livelock on time-randomizing generators like
+                        # stagger, which produce a fresh future delay on
+                        # every poll (the deterministic interpreter in
+                        # generator/simulate.py commits the same way).
+                        self._gen = g2
+                        committed = dict(o)
+            if committed is not None:
+                while self._poison is None:
+                    wait = committed["time"] - self.now()
+                    if wait <= 0:
+                        return committed
+                    _time.sleep(min(wait / 1e9, _MAX_SLEEP))
+                return None
+            _time.sleep(_PENDING_SLEEP)
+
+    def on_invoke(self, invocation: dict) -> None:
+        """Journal an invoke event: thread leaves the free set."""
+        with self._lock:
+            thread = gen.process_to_thread(self._ctx, invocation["process"])
+            self._ctx["free_threads"] = tuple(
+                t for t in self._ctx["free_threads"] if t != thread
+            )
+            self._ctx["time"] = max(self._ctx["time"], invocation["time"])
+            self._gen = gen.update(
+                self._gen, self._test, self._ctx, invocation
+            )
+
+    def on_complete(self, completion: dict) -> None:
+        """Journal a completion: thread rejoins the free set; an :info
+        completion retires the process (crash cycling)."""
+        with self._lock:
+            thread = gen.process_to_thread(self._ctx, completion["process"])
+            self._ctx["time"] = max(self._ctx["time"], completion["time"])
+            self._gen = gen.update(
+                self._gen, self._test, self._ctx, completion
+            )
+            if thread is None:
+                return
+            if completion.get("type") == "info" and thread != NEMESIS:
+                self._ctx["workers"][thread] = gen.next_process(
+                    self._ctx, thread
+                )
+            self._ctx["free_threads"] = gen._sorted_threads(
+                set(self._ctx["free_threads"]) | {thread}
+            )
+
+
+class _HistoryRecorder:
+    """Thread-safe append-only op journal with relative-nanos stamping
+    (core.clj:55-59's conj-op! on an atom)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ops: List[Op] = []
+
+    def append(self, op: Op) -> Op:
+        with self._lock:
+            self._ops.append(op)
+            return op
+
+    def snapshot(self) -> List[Op]:
+        with self._lock:
+            return list(self._ops)
+
+
+def _invoke_client(client, test, op: Op) -> Op:
+    """client.invoke with the reference's exception conversion
+    (core.clj:199-232)."""
+    try:
+        completion = client.invoke(test, op)
+        if not isinstance(completion, Op) or completion.type not in (
+            "ok",
+            "fail",
+            "info",
+        ):
+            return op.with_(
+                type="info", error=f"bad completion: {completion!r}"
+            )
+        return completion
+    except ClientFailed as e:
+        return op.with_(type="fail", error=str(e) or "client failed")
+    except Exception as e:
+        return op.with_(type="info", error=f"{type(e).__name__}: {e}")
+
+
+class ClientWorker(threading.Thread):
+    """Per-thread op loop with crash cycling (core.clj:280-368)."""
+
+    def __init__(self, thread_id, node, test, sched: Scheduler,
+                 recorder: _HistoryRecorder):
+        super().__init__(name=f"jepsen-worker-{thread_id}", daemon=True)
+        self.thread_id = thread_id
+        self.node = node
+        self.test = test
+        self.sched = sched
+        self.recorder = recorder
+        self.client: Optional[Client] = None
+        self._setup_done = False
+        self.error: Optional[BaseException] = None
+
+    def _open_client(self) -> Optional[str]:
+        """Open (and on the worker's FIRST open, setup) a client.
+        Crash-cycle reopens skip setup — data setup is one-time, like
+        the reference's setup!/open! split (client.clj:8-27)."""
+        try:
+            self.client = self.test["client"].open(self.test, self.node)
+        except Exception as e:
+            self.client = None
+            return f"{type(e).__name__}: {e}"
+        if not self._setup_done:
+            try:
+                self.client.setup(self.test)
+                self._setup_done = True
+            except Exception as e:
+                self._close_client()
+                return f"{type(e).__name__}: {e}"
+        return None
+
+    def _close_client(self, teardown: bool = False) -> None:
+        if self.client is not None:
+            if teardown and self._setup_done:
+                try:
+                    self.client.teardown(self.test)
+                except Exception:
+                    pass
+            try:
+                self.client.close(self.test)
+            except Exception:
+                pass
+            self.client = None
+
+    def run(self) -> None:
+        test, sched, rec = self.test, self.sched, self.recorder
+        try:
+            while True:
+                o = sched.next_op(self.thread_id)
+                if o is None:
+                    break
+                op = Op(
+                    type="invoke",
+                    f=o.get("f"),
+                    value=o.get("value"),
+                    process=o["process"],
+                    time=sched.now(),
+                )
+                if self.client is None:
+                    err = self._open_client()
+                    if err is not None:
+                        # Synthetic fail pair; retry open on next op
+                        # (core.clj:313-328).
+                        inv = rec.append(op.with_(error=err))
+                        sched.on_invoke(_as_dict(inv))
+                        comp = rec.append(
+                            op.with_(
+                                type="fail", time=sched.now(), error=err
+                            )
+                        )
+                        sched.on_complete(_as_dict(comp))
+                        continue
+                inv = rec.append(op)
+                sched.on_invoke(_as_dict(inv))
+                completion = _invoke_client(self.client, test, inv)
+                completion = completion.with_(time=sched.now())
+                rec.append(completion)
+                sched.on_complete(_as_dict(completion))
+                if completion.type == "info":
+                    # Crash: retire process, cycle the client
+                    # (core.clj:338-355).
+                    self._close_client()
+        except BaseException as e:  # runtime bug: abort the whole run
+            self.error = e
+            sched.poison(e)
+        finally:
+            self._close_client(teardown=True)
+
+
+class NemesisWorker(threading.Thread):
+    """Nemesis op loop (core.clj:370-401): ops route to the test's
+    nemesis; exceptions become :info completions and are never
+    retried."""
+
+    def __init__(self, test, sched: Scheduler, recorder: _HistoryRecorder):
+        super().__init__(name="jepsen-nemesis", daemon=True)
+        self.test = test
+        self.sched = sched
+        self.recorder = recorder
+        self.error: Optional[BaseException] = None
+
+    def run(self) -> None:
+        test, sched, rec = self.test, self.sched, self.recorder
+        nemesis = test.get("nemesis")
+        try:
+            while True:
+                o = sched.next_op(NEMESIS)
+                if o is None:
+                    break
+                inv = rec.append(
+                    Op(
+                        type="invoke",
+                        f=o.get("f"),
+                        value=o.get("value"),
+                        process=NEMESIS,
+                        time=sched.now(),
+                    )
+                )
+                sched.on_invoke(_as_dict(inv))
+                if nemesis is None:
+                    comp = inv.with_(type="info", time=sched.now())
+                else:
+                    try:
+                        comp = nemesis.invoke(test, inv)
+                        if not isinstance(comp, Op):
+                            comp = inv.with_(type="info")
+                    except Exception as e:
+                        comp = inv.with_(
+                            type="info", error=f"{type(e).__name__}: {e}"
+                        )
+                    comp = comp.with_(time=sched.now())
+                rec.append(comp)
+                sched.on_complete(_as_dict(comp))
+        except BaseException as e:
+            self.error = e
+            sched.poison(e)
+
+
+def _as_dict(op: Op) -> dict:
+    return {
+        "type": op.type,
+        "f": op.f,
+        "value": op.value,
+        "process": op.process,
+        "time": op.time,
+    }
+
+
+def run(test: Dict[str, Any]) -> Dict[str, Any]:
+    """Run a test spec end-to-end in-process and analyze the history.
+
+    The spec is a plain dict of protocol slots, the same data-first
+    shape as the reference's test map (core.clj:467-515):
+
+      client       Client prototype (opened per worker)
+      nemesis      optional Nemesis
+      generator    pure generator of client ops
+      checker      optional checker with .check(test, history, opts)
+      concurrency  worker count (default 5)
+      nodes        list of node names (workers round-robin over them;
+                   default ["n1".."n5"])
+      name         test name (default "noname")
+
+    Returns the test dict extended with "history" (History) and
+    "results" (checker output; {"valid?": True} when no checker).
+    """
+    test = dict(test)
+    test.setdefault("name", "noname")
+    test.setdefault("concurrency", 5)
+    test.setdefault("nodes", [f"n{i}" for i in range(1, 6)])
+    test.setdefault("start_time", _time.time())
+    n = test["concurrency"]
+    nodes = test["nodes"]
+
+    threads = list(range(n)) + [NEMESIS]
+    t0 = _time.monotonic_ns()
+    sched = Scheduler(test.get("generator"), test, threads, t0)
+    rec = _HistoryRecorder()
+
+    workers = [
+        ClientWorker(i, nodes[i % len(nodes)], test, sched, rec)
+        for i in range(n)
+    ]
+    nw = NemesisWorker(test, sched, rec)
+    for w in workers:
+        w.start()
+    nw.start()
+    for w in workers:
+        w.join()
+    nw.join()
+
+    if sched.poisoned is not None:
+        for w in workers + [nw]:
+            if w.error is not None and w.error is sched.poisoned:
+                raise w.error
+        raise sched.poisoned
+
+    history = History(rec.snapshot())
+    test["history"] = history
+    checker = test.get("checker")
+    if checker is not None:
+        test["results"] = checker.check(test, history, {})
+    else:
+        test["results"] = {"valid?": True}
+    return test

@@ -273,3 +273,74 @@ def test_small_frontier_escalation_still_definite():
     want = check_events(ev)
     got = check_events_bucketed(ev, k_ladder=(2, 64))
     assert got["valid?"] == want
+
+
+# -- v2 kernel features: pruning, wide windows, failure artifacts ------------
+
+
+def test_oracle_prune_matches_noprune():
+    # Dominance pruning must be exactness-preserving.
+    for seed in range(40):
+        rng = random.Random(4000 + seed)
+        h = gen_history(rng, n_ops=18, n_procs=4, p_crash=0.3)
+        if seed % 2:
+            h = corrupt(h, rng)
+        ev = history_to_events(h)
+        assert check_events(ev, prune=True) == check_events(
+            ev, prune=False
+        ), f"seed {seed}"
+
+
+def test_kernel_handles_crash_heavy_history():
+    # Enough crashed writes that the unpruned frontier would explode.
+    rng = random.Random(99)
+    h = gen_history(rng, n_ops=400, n_procs=5, p_crash=0.05)
+    ev = history_to_events(h)
+    want = check_events(ev)
+    got = check_events_bucketed(ev)
+    assert got["valid?"] == want
+    assert got["method"] == "tpu-wgl"  # pruning keeps it on-device
+
+
+def test_wide_window_past_31():
+    # >32 concurrently-open ops (crashed writes accumulate): exercises
+    # the multi-word masks. All ops overlapping -> any value readable.
+    from jepsen_tpu.history.ops import info_op, invoke_op, ok_op
+
+    ops = []
+    for i in range(40):  # 40 crashed writes of distinct values
+        ops.append(invoke_op(i, "write", i))
+        ops.append(info_op(i, "write", i))
+    ops.append(invoke_op(100, "read"))
+    ops.append(ok_op(100, "read", 17))
+    ev = history_to_events(H(*ops))
+    assert ev.window > 32
+    got = check_events_bucketed(ev)
+    assert got["valid?"] is True
+    assert got["method"] == "tpu-wgl"
+
+
+def test_failed_op_index_reported():
+    h = H(
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),      # index 1
+        invoke_op(0, "read"),
+        ok_op(0, "read", None),    # index 3 <- the impossible stale read
+    )
+    got = check_events_bucketed(history_to_events(h))
+    assert got["valid?"] is False
+    assert got["failed_op_index"] == 3
+
+
+def test_failed_op_index_matches_oracle():
+    for seed in range(25):
+        rng = random.Random(5000 + seed)
+        h = corrupt(gen_history(rng, n_ops=25, n_procs=4), rng)
+        ev = history_to_events(h)
+        want, stats = check_events(ev, return_stats=True)
+        got = check_events_bucketed(ev)
+        assert got["valid?"] == want
+        if not want:
+            assert got["failed_op_index"] == stats["failed_op_index"], (
+                f"seed {seed}"
+            )

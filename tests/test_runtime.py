@@ -1,0 +1,236 @@
+"""Runtime tests, mirroring the reference's core_test.clj scenarios
+(test/jepsen/core_test.clj:40-178) against the in-memory atom client —
+full lifecycle, zero I/O — with the history checked by the real
+linearizability engine (tests.clj:26-57's atom-db trick)."""
+
+import random
+import threading
+
+import pytest
+
+from jepsen_tpu.checker.linearizable import LinearizableChecker
+from jepsen_tpu.generator import pure as gen
+from jepsen_tpu.history.ops import Op
+from jepsen_tpu.runtime import AtomClient, Client, ClientFailed, run
+
+
+def r():
+    return {"f": "read"}
+
+
+def w(rng):
+    return lambda: {"f": "write", "value": rng.randrange(5)}
+
+
+def cas(rng):
+    return lambda: {
+        "f": "cas",
+        "value": [rng.randrange(5), rng.randrange(5)],
+    }
+
+
+def register_gen(n_ops, rng=None, dt=0.0001):
+    rng = rng or random.Random(0)
+    return gen.limit(
+        n_ops,
+        gen.stagger(dt, gen.mix([r(), w(rng), cas(rng)], rng=rng), rng=rng),
+    )
+
+
+def test_basic_cas_run_checks_linearizable():
+    # core_test.clj:40-52 basic-cas-test, with the verdict produced by
+    # the actual WGL engine instead of knossos.
+    test = run({
+        "name": "basic-cas",
+        "client": AtomClient(),
+        "generator": register_gen(120),
+        "checker": LinearizableChecker(),
+        "concurrency": 5,
+    })
+    h = test["history"]
+    assert len(h.ops) >= 240  # each op has invoke + completion
+    assert test["results"]["valid?"] is True
+
+
+def test_history_is_concurrent_and_well_formed():
+    test = run({
+        "client": AtomClient(),
+        "generator": register_gen(60),
+        "concurrency": 3,
+    })
+    h = test["history"]
+    # every invoke has exactly one completion, same process
+    pairs = h.pairs()
+    invokes = [o for o in h.ops if o.is_invoke]
+    assert len(invokes) == 60
+    completions = [o for o in h.ops if not o.is_invoke]
+    assert len(completions) == 60
+    # times are monotone nonneg and process-consistent
+    assert all(o.time >= 0 for o in h.ops)
+
+
+class CrashingClient(Client):
+    """Every invoke explodes -> :info -> process retirement."""
+
+    def __init__(self, counter):
+        self.counter = counter
+
+    def open(self, test, node):
+        return CrashingClient(self.counter)
+
+    def invoke(self, test, op):
+        with self.counter["lock"]:
+            self.counter["n"] += 1
+        raise RuntimeError("boom")
+
+
+def test_worker_recovery_crash_cycling():
+    # core_test.clj:110-128: every invoke crashes; the run must consume
+    # exactly n ops, cycling process ids, and every completion is :info.
+    counter = {"n": 0, "lock": threading.Lock()}
+    test = run({
+        "client": CrashingClient(counter),
+        "generator": gen.limit(20, {"f": "read"}),
+        "concurrency": 4,
+    })
+    h = test["history"]
+    infos = [o for o in h.ops if o.type == "info"]
+    assert counter["n"] == 20
+    assert len(infos) == 20
+    # crash cycling: retired processes never reappear in invokes
+    seen = []
+    for o in h.ops:
+        if o.is_invoke:
+            seen.append(o.process)
+    assert len(seen) == 20
+    # some process ids beyond the initial concurrency prove cycling
+    assert any(p >= 4 for p in seen)
+    # a process id never invokes again after its :info
+    crashed = set()
+    for o in h.ops:
+        if o.is_invoke:
+            assert o.process not in crashed
+        elif o.type == "info":
+            crashed.add(o.process)
+
+
+class ExplodingGen(gen.Generator):
+    def __init__(self, inner, after):
+        self.inner = inner
+        self.after = after
+
+    def op(self, test, ctx):
+        if self.after <= 0:
+            raise RuntimeError("generator exploded")
+        pair = gen.op(self.inner, test, ctx)
+        if pair is None:
+            return None
+        o, g2 = pair
+        return o, ExplodingGen(g2, self.after - 1)
+
+    def update(self, test, ctx, event):
+        return ExplodingGen(
+            gen.update(self.inner, test, ctx, event), self.after
+        )
+
+
+def test_generator_recovery_unblocks_workers():
+    # core_test.clj:130-152: a generator exception must unblock all
+    # workers, close clients, and rethrow from run().
+    closed = {"n": 0, "lock": threading.Lock()}
+
+    class TrackingClient(AtomClient):
+        def open(self, test, node):
+            c = TrackingClient(self.register)
+            return c
+
+        def close(self, test):
+            with closed["lock"]:
+                closed["n"] += 1
+
+    with pytest.raises(RuntimeError, match="generator exploded"):
+        run({
+            "client": TrackingClient(),
+            "generator": ExplodingGen(register_gen(1000), after=10),
+            "concurrency": 3,
+        })
+    # all opened clients were closed on the way out
+    assert closed["n"] >= 1
+
+
+class FailingOpenClient(Client):
+    """open() fails the first k times per node."""
+
+    def __init__(self, fails_left):
+        self.fails_left = fails_left
+
+    def open(self, test, node):
+        with self.fails_left["lock"]:
+            if self.fails_left["n"] > 0:
+                self.fails_left["n"] -= 1
+                raise ConnectionError("connect refused")
+        return AtomClient()
+
+
+def test_failed_open_yields_fail_ops_then_recovers():
+    # core.clj:313-328: failed opens journal synthetic :fail pairs and
+    # the worker retries on the next op.
+    fails = {"n": 3, "lock": threading.Lock()}
+    test = run({
+        "client": FailingOpenClient(fails),
+        "generator": gen.limit(30, {"f": "read"}),
+        "concurrency": 3,
+    })
+    h = test["history"]
+    fail_ops = [o for o in h.ops if o.type == "fail" and o.error]
+    ok_ops = [o for o in h.ops if o.type == "ok"]
+    assert len(fail_ops) == 3
+    assert len(ok_ops) == 27
+
+
+def test_client_failed_maps_to_fail():
+    class SometimesFails(Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            raise ClientFailed("rejected")
+
+    test = run({
+        "client": SometimesFails(),
+        "generator": gen.limit(5, {"f": "read"}),
+        "concurrency": 2,
+    })
+    h = test["history"]
+    assert sum(1 for o in h.ops if o.type == "fail") == 5
+    # fail ops never retire processes: all invokes use initial processes
+    assert all(o.process < 2 for o in h.ops if o.is_invoke)
+
+
+def test_nemesis_ops_are_journaled():
+    class FlagNemesis:
+        def invoke(self, test, op):
+            return op.with_(type="info", value="partitioned")
+
+    test = run({
+        "client": AtomClient(),
+        "nemesis": FlagNemesis(),
+        "generator": gen.any_gen(
+            register_gen(20),
+            gen.nemesis(gen.limit(2, {"f": "start"})),
+        ),
+        "concurrency": 2,
+    })
+    h = test["history"]
+    nem_ops = [o for o in h.ops if o.process == "nemesis"]
+    assert len(nem_ops) == 4  # 2 invokes + 2 infos
+    assert any(o.value == "partitioned" for o in nem_ops)
+
+
+def test_time_limited_run_terminates():
+    test = run({
+        "client": AtomClient(),
+        "generator": gen.time_limit(0.3, register_gen(10**9, dt=0.001)),
+        "concurrency": 3,
+    })
+    assert len(test["history"].ops) > 0

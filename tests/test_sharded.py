@@ -49,6 +49,7 @@ def test_graft_entry_contract():
     import __graft_entry__ as g
 
     fn, args = g.entry()
-    alive, overflow = jax.jit(fn)(*args)
+    alive, overflow, died = jax.jit(fn)(*args)
     assert bool(alive) is True
+    assert int(died) == -1
     g.dryrun_multichip(8)
