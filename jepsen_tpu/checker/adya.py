@@ -1,0 +1,53 @@
+"""Adya G2 (anti-dependency cycle) checker.
+
+Reference semantics: jepsen/src/jepsen/tests/adya.clj:62-88 — the G2
+workload issues exactly two predicate-guarded inserts per key (one per
+transaction); under serializability at most ONE may commit, because
+each transaction's predicate read must observe the other's insert if it
+committed first. Two ok inserts for one key witness an anti-dependency
+cycle (write-skew on predicates).
+
+The check itself is a per-key ok-insert count — a columnar group count,
+host-side (object keys); histories here are small per key by
+construction (2 inserts), so the interesting scale is key count, which
+this handles in one dict pass.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class G2Checker:
+    """g2-checker analog (adya.clj:62-88). Ops look like
+    {f: "insert", value: (key, (a_id, b_id))}; ok completions count."""
+
+    def check(self, test, history, opts=None) -> dict:
+        from jepsen_tpu.history.history import History
+
+        if not isinstance(history, History):
+            history = History(list(history))
+        counts: Dict[Any, int] = {}
+        for o in history.ops:
+            if o.f != "insert" or not isinstance(
+                o.value, (list, tuple)
+            ) or len(o.value) != 2:
+                continue
+            k = o.value[0]
+            if o.is_ok:
+                counts[k] = counts.get(k, 0) + 1
+            else:
+                counts.setdefault(k, 0)
+        illegal = {k: c for k, c in sorted(counts.items()) if c > 1}
+        insert_count = sum(1 for c in counts.values() if c > 0)
+        return {
+            "valid?": not illegal,
+            "key_count": len(counts),
+            "legal_count": insert_count - len(illegal),
+            "illegal_count": len(illegal),
+            "illegal": illegal,
+        }
+
+
+def g2_checker() -> G2Checker:
+    return G2Checker()

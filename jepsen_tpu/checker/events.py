@@ -191,7 +191,93 @@ def crashed_invokes(events: EventStream) -> np.ndarray:
 
 
 def events_to_steps(events: EventStream, W: int) -> ReturnSteps:
-    """Precompile an event stream into per-return window snapshots."""
+    """Precompile an event stream into per-return window snapshots.
+
+    Vectorized (no per-event Python loop): per-slot last-writer indices
+    come from a masked np.maximum.accumulate forward fill, window
+    snapshots are row-gathers of the filled arrays at (return_pos - 1),
+    and the monotone crashed mask is a np.bitwise_or.accumulate. A 100k
+    op history precompiles in tens of milliseconds instead of seconds —
+    this runs on every check, so it's part of the measured pipeline.
+    """
+    if events.window > W:
+        raise ValueError(f"window {events.window} exceeds W={W}")
+    nw = n_words(W)
+    n = len(events)
+    if n == 0:
+        return ReturnSteps(
+            occ=np.zeros((0, W), bool),
+            f=np.zeros((0, W), np.int32),
+            a=np.zeros((0, W), np.int32),
+            b=np.zeros((0, W), np.int32),
+            slot=np.zeros(0, np.int32),
+            live=np.zeros(0, bool),
+            crashed=np.zeros((0, nw), np.int32),
+            op_index=np.zeros(0, np.int32),
+            init_state=events.init_state,
+            W=W,
+        )
+
+    kind = events.kind
+    slot = events.slot
+    is_inv = kind == EV_INVOKE
+    is_ret = kind == EV_RETURN
+    ret_pos = np.nonzero(is_ret)[0]
+    n_ret = int(ret_pos.shape[0])
+
+    # Last-event index per (event, slot): -1 = never touched. One
+    # column per slot; an event writes only its own slot's column.
+    idx = np.full((n, W), -1, np.int64)
+    ev_i = np.arange(n)
+    touch = is_inv | is_ret
+    idx[ev_i[touch], slot[touch]] = ev_i[touch]
+    last = np.maximum.accumulate(idx, axis=0)
+    # Snapshot state BEFORE each return event: prefix excludes the
+    # return itself (ret_pos >= 1 always — an invoke precedes).
+    pre = last[ret_pos - 1]  # [n_ret, W]
+    valid = pre >= 0
+    gather = np.where(valid, pre, 0)
+    out_occ = valid & is_inv[gather]  # occupied iff last touch invoked
+    out_f = np.where(out_occ, events.f[gather], 0).astype(np.int32)
+    out_a = np.where(out_occ, events.a[gather], 0).astype(np.int32)
+    out_b = np.where(out_occ, events.b[gather], 0).astype(np.int32)
+
+    # Crashed slots: an invoke with no later event on its slot (crashed
+    # slots are never recycled, so it's always the slot's LAST event).
+    final = last[-1]
+    crashed_slots = np.nonzero((final >= 0) & is_inv[np.where(
+        final >= 0, final, 0
+    )])[0]
+    bits = slot_bit_table(W)
+    word = np.zeros((n, nw), np.int32)
+    for s in crashed_slots:
+        word[final[s]] |= bits[s]
+    cum = np.bitwise_or.accumulate(word, axis=0)
+    out_crash = cum[ret_pos - 1]
+
+    out_slot = slot[ret_pos].astype(np.int32)
+    if events.op_index is not None:
+        out_opidx = events.op_index[ret_pos].astype(np.int32)
+    else:
+        out_opidx = np.full(n_ret, -1, np.int32)
+    return ReturnSteps(
+        occ=out_occ,
+        f=out_f,
+        a=out_a,
+        b=out_b,
+        slot=out_slot,
+        live=np.ones(n_ret, bool),
+        crashed=out_crash,
+        op_index=out_opidx,
+        init_state=events.init_state,
+        W=W,
+    )
+
+
+def events_to_steps_loop(events: EventStream, W: int) -> ReturnSteps:
+    """Reference per-event loop implementation of events_to_steps —
+    kept as the differential-testing anchor for the vectorized
+    version."""
     if events.window > W:
         raise ValueError(f"window {events.window} exceeds W={W}")
     nw = n_words(W)

@@ -31,6 +31,8 @@ from __future__ import annotations
 import time
 from typing import Any, Optional
 
+import numpy as np
+
 from jepsen_tpu.checker.events import (
     EventStream,
     WindowOverflow,
@@ -121,13 +123,38 @@ def check_events_bucketed(
         return out
 
     steps = events_to_steps(events, W=W)
+    # Crash-heavy histories blow past the first rung almost surely (the
+    # pruned frontier still grows with the crashed-op antichain), so
+    # skip rungs that measured frontier statistics say are doomed: with
+    # c crashed slots the pruned width commonly reaches ~2^min(c,8)+.
+    # (Counted BEFORE padding — pad rows have all-zero crash masks.)
+    n_crashed = (
+        int(np.unpackbits(steps.crashed[-1].view(np.uint8)).sum())
+        if len(steps)
+        else 0
+    )
     steps = steps.padded(_bucket_events(max(len(steps), 1)))
+    on_tpu_now = _on_tpu()
+    if n_crashed >= 6:
+        # Only skip ahead if a bigger rung is actually runnable at this
+        # (W, NW) — otherwise keep the small rungs (a wide window with
+        # many crashed ops can still have a tiny pruned frontier).
+        bigger = tuple(
+            K for K in k_ladder
+            if K >= 256
+            and (
+                (on_tpu_now and _pallas_ok(K, W, steps.NW))
+                or _jax_ok(K, W, steps.NW)
+            )
+        )
+        if bigger:
+            k_ladder = bigger
     # On a real TPU with single-word masks, the Pallas megakernel runs
     # the whole scan in one fused kernel (~10x the pure-JAX scan, which
     # pays per-op dispatch for every return step). The pure-JAX path
     # remains the fallback for wide windows, big-K rungs that exceed the
     # kernel's VMEM budget, CPU meshes, and shard_map.
-    on_tpu = _on_tpu()
+    on_tpu = on_tpu_now
     escalations = 0
     for K in k_ladder:
         if on_tpu and _pallas_ok(K, W, steps.NW):

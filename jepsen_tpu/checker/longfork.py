@@ -1,0 +1,189 @@
+"""Long-fork detector: the parallel-snapshot-isolation anomaly where
+two concurrent writes are observed in conflicting orders by different
+readers.
+
+Reference semantics: jepsen/src/jepsen/tests/long_fork.clj — write txns
+are single writes of unique keys, read txns read a whole n-key group;
+two reads *fork* when each observes a write the other missed
+(read-compare returning incomparable, long_fork.clj:158-196); multiple
+writes to one key make the history unknown, distinct non-nil values for
+one key make it illegal.
+
+TPU-first design: since every key is written at most once, a read's
+observation per key reduces to present/absent. Each group's reads pack
+into a binary [R, n] matrix V, and fork detection is ONE matmul:
+
+    G = (V @ (1 - V).T) > 0        # G[a,b]: a saw something b missed
+    forks = G & G.T (off-diagonal)
+
+The pairwise comparison the reference does read-by-read becomes an
+[R, n] x [n, R] product on the MXU; groups batch along a leading axis
+(padded to the widest group) so a 256-key x 500k-op history (BASELINE
+config 5) is a single batched matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from jepsen_tpu import txn as txnlib
+
+
+@functools.lru_cache(maxsize=1)
+def _fork_kernel():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def forks(V, live):
+        """V [G, R, n] float32 0/1 presence; live [G, R] bool (padding
+        rows dead). Returns [G, R, R] bool fork-pair matrix."""
+        missed = jnp.einsum("grk,gsk->grs", V, 1.0 - V) > 0.5
+        both = live[:, :, None] & live[:, None, :]
+        pair = missed & jnp.swapaxes(missed, 1, 2) & both
+        return pair
+
+    return forks
+
+
+def _bucket(n: int, lo: int = 8) -> int:
+    size = lo
+    while size < n:
+        size *= 2
+    return size
+
+
+class LongForkChecker:
+    """checker(n) analog (long_fork.clj:296-316)."""
+
+    def __init__(self, n: int = 2):
+        self.n = n
+
+    def check(self, test, history, opts=None) -> dict:
+        from jepsen_tpu.history.history import History
+
+        if not isinstance(history, History):
+            history = History(list(history))
+
+        # Multiple writes to one key -> unknown (long_fork.clj:259-275).
+        written = set()
+        for o in history.ops:
+            if o.is_invoke and self._is_write_txn(o.value):
+                k = o.value[0][1]
+                if k in written:
+                    return {
+                        "valid?": "unknown",
+                        "error": ["multiple-writes", k],
+                    }
+                written.add(k)
+
+        reads = [
+            o for o in history.ops
+            if o.is_ok and self._is_read_txn(o.value)
+        ]
+        early = late = 0
+        groups: Dict[Tuple, List[Tuple[Any, dict]]] = {}
+        for o in reads:
+            vals = {m[1]: m[2] for m in o.value}
+            if len(vals) != self.n:
+                return {
+                    "valid?": "unknown",
+                    "error": [
+                        "wrong-group-size", sorted(vals), "expected", self.n
+                    ],
+                }
+            if all(v is None for v in vals.values()):
+                early += 1
+            if all(v is not None for v in vals.values()):
+                late += 1
+            groups.setdefault(tuple(sorted(vals)), []).append((o, vals))
+
+        base = {
+            "reads_count": len(reads),
+            "early_read_count": early,
+            "late_read_count": late,
+        }
+
+        # Distinct non-nil values for one key -> illegal
+        # (read-compare's final throw, long_fork.clj:190-196).
+        for gkey, items in groups.items():
+            seen: Dict[Any, Any] = {}
+            for _, vals in items:
+                for k, v in vals.items():
+                    if v is None:
+                        continue
+                    if k in seen and seen[k] != v:
+                        return {
+                            **base,
+                            "valid?": "unknown",
+                            "error": ["distinct-values", k],
+                        }
+                    seen[k] = v
+
+        # Dedup each group's reads to DISTINCT observation states (at
+        # most 2^n, usually a handful): forks are a property of states,
+        # not of individual reads, so a 500k-op history collapses to a
+        # few states per group in one O(R) pass before the device
+        # matmul ever runs — the find-forks pairwise scan
+        # (long_fork.clj:216-224) is O(R^2) by comparison.
+        glist = []
+        for gkey, items in groups.items():
+            state_witness: Dict[Tuple, Any] = {}
+            for o, vals in items:
+                state = tuple(
+                    0 if vals[k] is None else 1 for k in gkey
+                )
+                state_witness.setdefault(state, o)
+            glist.append((gkey, list(state_witness.items())))
+        if glist:
+            Smax = _bucket(max(len(states) for _, states in glist))
+            G = len(glist)
+            V = np.zeros((G, Smax, self.n), np.float32)
+            live = np.zeros((G, Smax), bool)
+            for gi, (gkey, states) in enumerate(glist):
+                for si, (state, _) in enumerate(states):
+                    live[gi, si] = True
+                    V[gi, si, :] = state
+            pair = np.asarray(_fork_kernel()(V, live))
+            fork_list = []
+            for gi, ri, si in zip(*np.nonzero(np.triu(pair, k=1))):
+                a = glist[gi][1][ri][1]
+                b = glist[gi][1][si][1]
+                fork_list.append(
+                    [
+                        {"op_index": a.index, "value": a.value},
+                        {"op_index": b.index, "value": b.value},
+                    ]
+                )
+            if fork_list:
+                return {**base, "valid?": False, "forks": fork_list}
+        return {**base, "valid?": True}
+
+    @staticmethod
+    def _is_read_txn(v) -> bool:
+        return (
+            isinstance(v, (list, tuple))
+            and len(v) > 0
+            and all(
+                isinstance(m, (list, tuple)) and len(m) == 3
+                and m[0] == txnlib.R
+                for m in v
+            )
+        )
+
+    @staticmethod
+    def _is_write_txn(v) -> bool:
+        return (
+            isinstance(v, (list, tuple))
+            and len(v) == 1
+            and isinstance(v[0], (list, tuple))
+            and len(v[0]) == 3
+            and v[0][0] == txnlib.W
+        )
+
+
+def long_fork_checker(n: int = 2) -> LongForkChecker:
+    return LongForkChecker(n)

@@ -172,10 +172,49 @@ def check_keys(
         n_keys = ((n_real + n_dev - 1) // n_dev) * n_dev
     else:
         n_keys = n_real
-    cols = stack_streams(streams, W=W, n_keys=n_keys)
     K = k_ladder[0]
 
     if mesh is None:
+        from jepsen_tpu.checker.linearizable import _on_tpu, _pallas_ok
+        from jepsen_tpu.checker.events import n_words
+
+        if _on_tpu() and _pallas_ok(K, W, n_words(W)):
+            # One batched megakernel launch: keys form the outer grid
+            # dimension, one host sync for the whole batch.
+            from jepsen_tpu.checker.wgl_pallas import check_keys_pallas
+
+            steps = [events_to_steps(s, W=W) for s in streams]
+            outs = check_keys_pallas(steps, model=model, K=K)
+            alive = np.asarray([o[0] for o in outs])
+            overflow = np.asarray([o[1] for o in outs])
+            died = np.asarray([o[2] for o in outs])
+            out: List[dict] = []
+            for i, s in enumerate(streams):
+                if alive[i] or not overflow[i]:
+                    r = {
+                        "valid?": bool(alive[i]),
+                        "method": "tpu-wgl-pallas-batch",
+                        "frontier_k": K,
+                        "escalations": 0,
+                    }
+                    if not alive[i]:
+                        r["failed_op_index"] = int(died[i])
+                    out.append(r)
+                else:
+                    rest = k_ladder[1:]
+                    if rest:
+                        out.append(
+                            check_events_bucketed(
+                                s, model=model, k_ladder=rest
+                            )
+                        )
+                    else:  # no bigger rung: the oracle decides
+                        out.append({
+                            "valid?": oracle_check(s, model=model),
+                            "method": "cpu-oracle",
+                        })
+            return out
+        cols = stack_streams(streams, W=W, n_keys=n_keys)
         args = tuple(jnp.asarray(c) for c in cols)
         alive, overflow, died = _wgl_vmap(*args, model_name=model, K=K, W=W)
     else:
@@ -184,6 +223,7 @@ def check_keys(
         # (e.g. a virtual CPU mesh under an ambient TPU plugin).
         from jax.sharding import NamedSharding
 
+        cols = stack_streams(streams, W=W, n_keys=n_keys)
         spec = P(mesh.axis_names[0])
         sharding = NamedSharding(mesh, spec)
         args = tuple(jax.device_put(np.asarray(c), sharding) for c in cols)

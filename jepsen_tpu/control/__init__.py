@@ -1,0 +1,27 @@
+"""Control plane: remote execution over SSH, with dummy and local
+modes.
+
+Reference: jepsen/src/jepsen/control.clj (exec/upload/download, shell
+escaping, sudo/cd scoping, retries, the *dummy* stub) and
+reconnect.clj (self-healing session wrapper).
+"""
+
+from jepsen_tpu.control.core import (
+    DummyRemote,
+    LocalRemote,
+    RemoteError,
+    Session,
+    SshRemote,
+    escape,
+    on_nodes,
+)
+
+__all__ = [
+    "DummyRemote",
+    "LocalRemote",
+    "RemoteError",
+    "Session",
+    "SshRemote",
+    "escape",
+    "on_nodes",
+]

@@ -1,0 +1,97 @@
+"""Remote scripting helpers: daemons, signals, archives.
+
+Reference: jepsen/src/jepsen/control/util.clj — start-stop-daemon
+pidfile management (:208-251), grepkill (:191-206), SIGSTOP/SIGCONT
+(:266-270), cached archive install (:79-173).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional, Sequence
+
+from jepsen_tpu.control.core import RemoteError, Session
+
+
+def start_daemon(
+    session: Session,
+    binary: str,
+    *args,
+    pidfile: str,
+    logfile: str,
+    chdir: Optional[str] = None,
+    env: Optional[dict] = None,
+) -> None:
+    """Start a long-running process under a pidfile, stdout/stderr to
+    logfile (start-daemon!, control/util.clj:208-236). Uses setsid +
+    shell backgrounding rather than start-stop-daemon so it works on
+    any POSIX host."""
+    envs = " ".join(
+        f"{k}={v}" for k, v in (env or {}).items()
+    )
+    cmdline = " ".join([envs, binary, *[str(a) for a in args]]).strip()
+    script = (
+        f"setsid {cmdline} >> {logfile} 2>&1 < /dev/null & "
+        f"echo $! > {pidfile}"
+    )
+    session.exec("sh", "-c", script, cd=chdir)
+
+
+def daemon_running(session: Session, pidfile: str) -> bool:
+    """Is the pidfile's process alive? (daemon-running?,
+    control/util.clj:253-264)"""
+    try:
+        out = session.exec(
+            "sh", "-c", f"test -f {pidfile} && kill -0 $(cat {pidfile})"
+        )
+        return True
+    except RemoteError:
+        return False
+
+
+def stop_daemon(session: Session, pidfile: str,
+                signal: str = "TERM") -> None:
+    """Kill the pidfile's process and remove the pidfile
+    (stop-daemon!, control/util.clj:238-251)."""
+    session.exec(
+        "sh", "-c",
+        f"test -f {pidfile} && kill -{signal} $(cat {pidfile}) || true; "
+        f"rm -f {pidfile}",
+    )
+
+
+def grepkill(session: Session, pattern: str, signal: str = "KILL") -> None:
+    """Kill processes matching a pattern (grepkill!,
+    control/util.clj:191-206)."""
+    session.exec("pkill", f"-{signal}", "-f", pattern, check=False)
+
+
+def signal_proc(session: Session, process: str, signal: str) -> None:
+    """Send a signal by process name — SIGSTOP/SIGCONT for pause
+    nemeses (signal!, control/util.clj:266-270)."""
+    session.exec("killall", "-s", signal, process, sudo=True)
+
+
+def install_archive(
+    session: Session,
+    url: str,
+    dest_dir: str,
+    cache_dir: str = "/tmp/jepsen/cache",
+) -> None:
+    """Download (once — URL-keyed cache) and untar an archive into
+    dest_dir (install-archive!/cached-wget!, control/util.clj:79-173).
+    Retries a corrupt cached archive by re-downloading."""
+    key = hashlib.sha256(url.encode()).hexdigest()[:24]
+    cached = f"{cache_dir}/{key}.tar"
+    session.exec("mkdir", "-p", cache_dir, dest_dir)
+    session.exec(
+        "sh", "-c",
+        f"test -f {cached} || wget -q -O {cached} {url}",
+    )
+    try:
+        session.exec("tar", "-xf", cached, "-C", dest_dir)
+    except RemoteError:
+        # Corrupt cache: re-fetch once (control/util.clj:150-167).
+        session.exec("rm", "-f", cached)
+        session.exec("wget", "-q", "-O", cached, url)
+        session.exec("tar", "-xf", cached, "-C", dest_dir)

@@ -380,17 +380,39 @@ def run(test: Dict[str, Any]) -> Dict[str, Any]:
     sched = Scheduler(test.get("generator"), test, threads, t0)
     rec = _HistoryRecorder()
 
+    # Nemesis lifecycle (nemesis.clj:9-14): setup before workers spawn,
+    # teardown after they drain.
+    nem = test.get("nemesis")
+    if nem is not None and hasattr(nem, "setup"):
+        test["nemesis"] = nem = nem.setup(test)
+
     workers = [
         ClientWorker(i, nodes[i % len(nodes)], test, sched, rec)
         for i in range(n)
     ]
     nw = NemesisWorker(test, sched, rec)
-    for w in workers:
-        w.start()
-    nw.start()
-    for w in workers:
-        w.join()
-    nw.join()
+    try:
+        for w in workers:
+            w.start()
+        nw.start()
+        for w in workers:
+            w.join()
+        nw.join()
+    finally:
+        if nem is not None and hasattr(nem, "teardown"):
+            try:
+                nem.teardown(test)
+            except Exception as e:
+                # An un-torn-down nemesis leaves faults in place
+                # (partitions, stopped processes): surface it.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "nemesis teardown failed; injected faults may "
+                    "persist: %s", e
+                )
+                test["nemesis_teardown_error"] = f"{type(e).__name__}: {e}"
+
 
     if sched.poisoned is not None:
         for w in workers + [nw]:

@@ -1,0 +1,12 @@
+"""Workload kits: reusable generator + client + checker bundles.
+
+The analog of the reference's jepsen.tests.* packages
+(jepsen/src/jepsen/tests/, 906 LoC of workload kits — SURVEY.md §2 row
+26): each module exposes a `workload(**opts)` returning a dict of test
+map slots to merge into a test spec, plus an in-memory client so the
+whole stack runs (and is tested) with zero I/O.
+"""
+
+from jepsen_tpu.workloads import adya, bank, long_fork, register
+
+__all__ = ["adya", "bank", "long_fork", "register"]

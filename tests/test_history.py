@@ -242,3 +242,37 @@ def test_value_interning_type_aware():
     assert len({c_true, c_one, c_false, c_zero}) == 4
     assert enc.decode_value(c_true) is True
     assert enc.decode_value(c_one) == 1 and enc.decode_value(c_one) is not True
+
+
+def test_events_to_steps_vectorized_matches_loop():
+    import random as _random
+
+    import numpy as _np
+
+    from jepsen_tpu.checker.events import (
+        events_to_steps,
+        events_to_steps_loop,
+        history_to_events,
+    )
+    from jepsen_tpu.sim import gen_register_history
+
+    for seed in range(25):
+        rng = _random.Random(8800 + seed)
+        h = gen_register_history(
+            rng, n_ops=60, n_procs=4, p_crash=0.1 if seed % 2 else 0.0
+        )
+        ev = history_to_events(h)
+        W = 16 if ev.window <= 16 else 32
+        a = events_to_steps(ev, W=W)
+        b = events_to_steps_loop(ev, W=W)
+        for field in ("occ", "slot", "live", "crashed", "op_index"):
+            assert _np.array_equal(
+                getattr(a, field), getattr(b, field)
+            ), f"seed {seed} field {field}"
+        # f/a/b only matter on occupied slots (the kernel gates on occ;
+        # the loop version keeps stale values in freed slots).
+        for field in ("f", "a", "b"):
+            assert _np.array_equal(
+                getattr(a, field)[a.occ], getattr(b, field)[b.occ]
+            ), f"seed {seed} field {field}"
+        assert a.init_state == b.init_state and a.W == b.W

@@ -1,0 +1,269 @@
+"""Nemesis + net + control tests.
+
+Grudge algebra mirrors the reference's structural tests
+(test/jepsen/nemesis_test.clj:12-60); side-effecting nemeses run
+against the recording DummyRemote (exact command lines) and the
+in-process MemNet (full runtime partition tests with zero cluster).
+"""
+
+import random
+import time
+
+import pytest
+
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu import net as netlib
+from jepsen_tpu.control import DummyRemote, LocalRemote, RemoteError, Session
+from jepsen_tpu.control.core import on_nodes, sessions_for
+from jepsen_tpu.generator import pure as gen
+from jepsen_tpu.history.ops import Op, invoke_op
+from jepsen_tpu.runtime import run
+from jepsen_tpu.utils.util import majority
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+# -- grudge algebra ----------------------------------------------------------
+
+
+def test_bisect():
+    assert nem.bisect([1, 2, 3, 4]) == [[1, 2], [3, 4]]
+    assert nem.bisect(NODES) == [["n1", "n2"], ["n3", "n4", "n5"]]
+
+
+def test_split_one():
+    a, b = nem.split_one(NODES, loner="n3")
+    assert a == ["n3"]
+    assert b == ["n1", "n2", "n4", "n5"]
+
+
+def test_complete_grudge():
+    g = nem.complete_grudge(nem.bisect(NODES))
+    assert g["n1"] == {"n3", "n4", "n5"}
+    assert g["n4"] == {"n1", "n2"}
+    assert len(g) == 5
+
+
+def test_bridge():
+    g = nem.bridge(NODES)
+    # n3 is the bridge: absent from the grudge and snubbed by nobody.
+    assert "n3" not in g
+    assert g["n1"] == {"n4", "n5"}
+    assert g["n5"] == {"n1", "n2"}
+    for snubbed in g.values():
+        assert "n3" not in snubbed
+
+
+def test_majorities_ring():
+    # Every node sees a majority; no two nodes see the same majority
+    # (nemesis_test.clj:12-60's structural properties).
+    for n_nodes in (3, 5, 7):
+        nodes = [f"n{i}" for i in range(n_nodes)]
+        g = nem.majorities_ring(nodes, rng=random.Random(1))
+        m = majority(n_nodes)
+        assert set(g) == set(nodes)  # every node has an entry
+        views = set()
+        for node, snubbed in g.items():
+            visible = frozenset(set(nodes) - set(snubbed))
+            assert len(visible) == m, (node, visible)
+            assert node in visible
+            views.add(visible)
+        assert len(views) == n_nodes  # all majorities distinct
+
+
+# -- partitioner + MemNet ----------------------------------------------------
+
+
+def test_partitioner_against_memnet():
+    net = netlib.MemNet()
+    test = {"nodes": NODES, "net": net}
+    p = nem.partition_halves().setup(test)
+    out = p.invoke(test, invoke_op("nemesis", "start"))
+    assert out.type == "info" and out.value[0] == "isolated"
+    assert not net.allows("n3", "n1")
+    assert not net.allows("n1", "n4")
+    assert net.allows("n1", "n2")  # same side
+    out = p.invoke(test, invoke_op("nemesis", "stop"))
+    assert out.value == "network-healed"
+    assert net.allows("n3", "n1")
+
+
+def test_partition_creates_nonlinearizable_history():
+    # Full loop: partitioner -> MemNet -> replication-aware client ->
+    # recorded history -> WGL verdict. The stale reads on the isolated
+    # side must be caught.
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+    from jepsen_tpu.workloads.register import (
+        ReplicatedRegisterClient,
+        op_mix,
+    )
+
+    rng = random.Random(11)
+    client_gen = gen.clients(
+        gen.limit(250, gen.stagger(0.001, op_mix(rng), rng=rng))
+    )
+    nemesis_gen = gen.nemesis(
+        gen.limit(1, gen.stagger(0.1, {"f": "start"}, rng=rng))
+    )
+    test = run({
+        "nodes": ["n1", "n2", "n3", "n4"],
+        "net": netlib.MemNet(),
+        "client": ReplicatedRegisterClient(latency_s=0.003),
+        "nemesis": nem.partition_halves(),
+        "generator": gen.any_gen(client_gen, nemesis_gen),
+        "checker": LinearizableChecker(),
+        "concurrency": 4,
+    })
+    assert any(
+        o.process == "nemesis" and o.type == "info" for o in
+        test["history"].ops
+    )
+    assert test["results"]["valid?"] is False
+
+
+def test_healed_partition_stays_linearizable():
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+    from jepsen_tpu.workloads.register import (
+        ReplicatedRegisterClient,
+        op_mix,
+    )
+
+    rng = random.Random(12)
+    test = run({
+        "nodes": ["n1", "n2"],
+        "net": netlib.MemNet(),
+        "client": ReplicatedRegisterClient(),
+        "generator": gen.clients(
+            gen.limit(100, gen.stagger(0.0005, op_mix(rng), rng=rng))
+        ),
+        "checker": LinearizableChecker(),
+        "concurrency": 2,
+    })
+    assert test["results"]["valid?"] is True
+
+
+# -- compose -----------------------------------------------------------------
+
+
+class EchoNemesis(nem.Nemesis):
+    def __init__(self, tag):
+        self.tag = tag
+
+    def invoke(self, test, op):
+        return op.with_(type="info", value=[self.tag, op.f])
+
+
+def test_compose_routes_by_f_set():
+    c = nem.compose({
+        frozenset(["start", "stop"]): EchoNemesis("part"),
+        frozenset(["kill"]): EchoNemesis("killer"),
+    })
+    out = c.invoke({}, invoke_op("nemesis", "kill"))
+    assert out.value == ["killer", "kill"]
+    out = c.invoke({}, invoke_op("nemesis", "start"))
+    assert out.value == ["part", "start"]
+    with pytest.raises(ValueError):
+        c.invoke({}, invoke_op("nemesis", "wat"))
+
+
+class _FrozenDict(dict):
+    def __hash__(self):
+        return hash(tuple(sorted(self.items())))
+
+
+def test_compose_translates_fs():
+    # dict-style routing key {outer-f: inner-f}: the op's f is
+    # translated for the child and restored on the completion
+    # (nemesis.clj:174-205's second example).
+    d = nem.compose({
+        _FrozenDict({"split-start": "start", "split-stop": "stop"}):
+            EchoNemesis("split"),
+    })
+    out = d.invoke({}, invoke_op("nemesis", "split-start"))
+    assert out.value == ["split", "start"]
+    assert out.f == "split-start"
+
+
+# -- control plane ------------------------------------------------------------
+
+
+def test_local_remote_exec_roundtrip(tmp_path):
+    s = Session(LocalRemote(), "local")
+    assert s.exec("echo", "hello world").strip() == "hello world"
+    with pytest.raises(RemoteError):
+        s.exec("false")
+    # upload/download
+    src = tmp_path / "a.txt"
+    src.write_text("payload")
+    s.upload(str(src), str(tmp_path / "b.txt"))
+    assert (tmp_path / "b.txt").read_text() == "payload"
+
+
+def test_dummy_remote_records_commands():
+    remote = DummyRemote()
+    test = {"nodes": NODES, "remote": remote}
+    on_nodes(test, lambda n, s: s.exec("hostname"))
+    assert sorted(e["node"] for e in remote.log) == sorted(NODES)
+
+
+def test_hammer_time_emits_signals():
+    remote = DummyRemote()
+    test = {"nodes": NODES, "remote": remote}
+    h = nem.hammer_time("etcd", targeter=lambda ns: ns[0])
+    out = h.invoke(test, invoke_op("nemesis", "start"))
+    assert out.value == {"n1": ["paused", "etcd"]}
+    out = h.invoke(test, invoke_op("nemesis", "start"))
+    assert "already disrupting" in out.value
+    out = h.invoke(test, invoke_op("nemesis", "stop"))
+    assert out.value == {"n1": ["resumed", "etcd"]}
+    cmds = remote.commands("n1")
+    assert any("killall -s STOP etcd" in c for c in cmds)
+    assert any("killall -s CONT etcd" in c for c in cmds)
+    assert all("sudo" in c for c in cmds)
+
+
+def test_truncate_file_emits_truncate():
+    remote = DummyRemote()
+    test = {"nodes": NODES, "remote": remote}
+    t = nem.truncate_file()
+    t.invoke(test, invoke_op(
+        "nemesis", "truncate", {"n2": {"file": "/data/wal", "drop": 64}}
+    ))
+    cmds = remote.commands("n2")
+    assert any("truncate -c -s -64 /data/wal" in c for c in cmds)
+
+
+def test_iptables_net_command_shapes():
+    remote = DummyRemote(responses={"getent": (0, "10.0.0.9 x\n", "")})
+    test = {"nodes": NODES, "remote": remote, "net": netlib.IptablesNet()}
+    netlib.drop_all(test, {"n1": {"n3", "n4"}})
+    cmds = remote.commands("n1")
+    assert any(
+        "iptables -A INPUT -s" in c and "-j DROP -w" in c for c in cmds
+    )
+    netlib.heal(test)
+    assert any("iptables -F -w" in c for c in remote.commands("n2"))
+
+
+def test_timeout_wrapper():
+    class SlowNemesis(nem.Nemesis):
+        def invoke(self, test, op):
+            time.sleep(2)
+            return op.with_(type="info", value="done")
+
+    t = nem.timeout(0.1, SlowNemesis())
+    out = t.invoke({}, invoke_op("nemesis", "start"))
+    assert out.value == "timeout"
+    out = nem.timeout(5, EchoNemesis("x")).invoke(
+        {}, invoke_op("nemesis", "go")
+    )
+    assert out.value == ["x", "go"]
+
+
+def test_clock_scrambler_emits_date():
+    remote = DummyRemote()
+    test = {"nodes": ["n1"], "remote": remote}
+    c = nem.clock_scrambler(60, rng=random.Random(3))
+    out = c.invoke(test, invoke_op("nemesis", "scramble"))
+    assert out.type == "info"
+    assert any("date" in c_ for c_ in remote.commands("n1"))

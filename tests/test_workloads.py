@@ -1,0 +1,346 @@
+"""Transactional/anomaly checkers + workload kits.
+
+Literal-history cases port the reference's semantics (bank.clj,
+long_fork.clj, adya.clj, causal.clj); the runtime-driven cases prove
+each workload end-to-end with its in-memory client — correct clients
+must check valid, the deliberately-broken client modes must be caught.
+"""
+
+import random
+
+import pytest
+
+from jepsen_tpu import independent
+from jepsen_tpu.checker.adya import G2Checker
+from jepsen_tpu.checker.bank import BankChecker
+from jepsen_tpu.checker.causal import CausalChecker, CausalReverseChecker
+from jepsen_tpu.checker.longfork import LongForkChecker
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.ops import invoke_op, ok_op
+from jepsen_tpu.runtime import run
+from jepsen_tpu.workloads import adya, bank, long_fork, register
+
+
+BANK_TEST = {"accounts": list(range(4)), "total_amount": 40}
+
+
+def bank_read(proc, balances, index_base=0):
+    return [invoke_op(proc, "read"), ok_op(proc, "read", balances)]
+
+
+# -- bank --------------------------------------------------------------------
+
+
+def test_bank_valid_reads():
+    h = History(
+        bank_read(0, {0: 10, 1: 10, 2: 10, 3: 10})
+        + bank_read(1, {0: 0, 1: 20, 2: 15, 3: 5})
+    )
+    r = BankChecker().check(BANK_TEST, h)
+    assert r["valid?"] is True
+    assert r["read_count"] == 2
+
+
+def test_bank_wrong_total():
+    h = History(
+        bank_read(0, {0: 10, 1: 10, 2: 10, 3: 11})
+        + bank_read(1, {0: 10, 1: 10, 2: 10, 3: 10})
+    )
+    r = BankChecker().check(BANK_TEST, h)
+    assert r["valid?"] is False
+    assert r["errors"]["wrong-total"]["count"] == 1
+    assert r["errors"]["wrong-total"]["first"]["total"] == 41
+    assert r["first_error"]["op_index"] == 1
+
+
+def test_bank_nil_and_negative_and_unexpected():
+    h = History(
+        bank_read(0, {0: 10, 1: None, 2: 10, 3: 20})
+        + bank_read(1, {0: -5, 1: 25, 2: 10, 3: 10})
+        + bank_read(2, {0: 10, 1: 10, 2: 10, 3: 10, "x": 0})
+    )
+    r = BankChecker().check(BANK_TEST, h)
+    assert r["valid?"] is False
+    assert r["errors"]["nil-balance"]["count"] == 1
+    assert r["errors"]["negative-value"]["count"] == 1
+    assert r["errors"]["unexpected-key"]["count"] == 1
+    # negative balances allowed -> only nil + unexpected remain
+    r2 = BankChecker(negative_balances=True).check(BANK_TEST, h)
+    assert "negative-value" not in r2["errors"]
+
+
+def test_bank_missing_account_is_wrong_total():
+    h = History(bank_read(0, {0: 10, 1: 10, 2: 10}))
+    r = BankChecker().check(BANK_TEST, h)
+    assert r["valid?"] is False
+    assert r["errors"]["wrong-total"]["first"]["total"] == 30
+
+
+def test_bank_runtime_snapshot_valid():
+    spec = bank.workload(n_ops=200, rng=random.Random(1))
+    test = run({**spec, "concurrency": 5})
+    assert test["results"]["valid?"] is True
+    assert test["results"]["read_count"] > 10
+
+
+def test_bank_runtime_torn_reads_caught():
+    spec = bank.workload(
+        n_ops=300, rng=random.Random(2), snapshot_reads=False
+    )
+    test = run({**spec, "concurrency": 5})
+    # Torn (non-transactional) reads must produce wrong totals.
+    assert test["results"]["valid?"] is False
+    assert "wrong-total" in test["results"]["errors"]
+
+
+# -- long fork ---------------------------------------------------------------
+
+
+def lf_read(proc, pairs):
+    v = [["r", k, val] for k, val in pairs]
+    return [invoke_op(proc, "read", [["r", k, None] for k, _ in pairs]),
+            ok_op(proc, "read", v)]
+
+
+def lf_write(proc, k):
+    v = [["w", k, 1]]
+    return [invoke_op(proc, "write", v), ok_op(proc, "write", v)]
+
+
+def test_long_fork_classic_anomaly():
+    # T3: x=nil y=1; T4: x=1 y=nil — the docstring example
+    # (long_fork.clj:1-13).
+    h = History(
+        lf_write(0, 0)
+        + lf_write(1, 1)
+        + lf_read(2, [(0, None), (1, 1)])
+        + lf_read(3, [(0, 1), (1, None)])
+    )
+    r = LongForkChecker(2).check({}, h)
+    assert r["valid?"] is False
+    assert len(r["forks"]) == 1
+
+
+def test_long_fork_valid_progression():
+    h = History(
+        lf_write(0, 0)
+        + lf_read(1, [(0, None), (1, None)])
+        + lf_read(2, [(0, 1), (1, None)])
+        + lf_write(1, 1)
+        + lf_read(3, [(0, 1), (1, 1)])
+    )
+    r = LongForkChecker(2).check({}, h)
+    assert r["valid?"] is True
+    assert r["reads_count"] == 3
+    assert r["early_read_count"] == 1
+    assert r["late_read_count"] == 1
+
+
+def test_long_fork_multiple_writes_unknown():
+    h = History(lf_write(0, 0) + lf_write(1, 0))
+    r = LongForkChecker(2).check({}, h)
+    assert r["valid?"] == "unknown"
+    assert r["error"][0] == "multiple-writes"
+
+
+def test_long_fork_runtime_honest_client_valid():
+    spec = long_fork.workload(n_ops=150, rng=random.Random(3))
+    test = run({**spec, "concurrency": 4})
+    assert test["results"]["valid?"] is True
+    assert test["results"]["reads_count"] > 5
+
+
+def test_long_fork_runtime_forked_replicas_caught():
+    spec = long_fork.workload(
+        n_ops=300, rng=random.Random(4), forked=True
+    )
+    test = run({**spec, "concurrency": 4})
+    assert test["results"]["valid?"] is False
+    assert test["results"]["forks"]
+
+
+# -- adya G2 -----------------------------------------------------------------
+
+
+def test_g2_two_ok_inserts_invalid():
+    h = History([
+        invoke_op(0, "insert", (5, (1, None))),
+        ok_op(0, "insert", (5, (1, None))),
+        invoke_op(1, "insert", (5, (None, 2))),
+        ok_op(1, "insert", (5, (None, 2))),
+    ])
+    r = G2Checker().check({}, h)
+    assert r["valid?"] is False
+    assert r["illegal"] == {5: 2}
+
+
+def test_g2_one_ok_insert_valid():
+    h = History([
+        invoke_op(0, "insert", (5, (1, None))),
+        ok_op(0, "insert", (5, (1, None))),
+        invoke_op(1, "insert", (5, (None, 2))),
+        invoke_op(1, "insert", (5, (None, 2))).with_(type="fail"),
+    ])
+    r = G2Checker().check({}, h)
+    assert r["valid?"] is True
+    assert r["key_count"] == 1
+
+
+def test_g2_runtime_serializable_valid():
+    spec = adya.workload(n_keys=10, serializable=True)
+    test = run({**spec, "concurrency": 4})
+    assert test["results"]["valid?"] is True
+
+
+def test_g2_runtime_weak_predicates_caught():
+    spec = adya.workload(n_keys=15, serializable=False)
+    test = run({**spec, "concurrency": 4})
+    assert test["results"]["valid?"] is False
+    assert test["results"]["illegal_count"] >= 1
+
+
+# -- causal ------------------------------------------------------------------
+
+
+def causal_op(proc, f, value, pos, link):
+    inv = invoke_op(proc, f, value).with_(position=pos, link=link)
+    done = ok_op(proc, f, value).with_(position=pos, link=link)
+    return [inv, done]
+
+
+def test_causal_valid_chain():
+    h = History(
+        causal_op(0, "read-init", 0, pos=1, link="init")
+        + causal_op(0, "write", 1, pos=2, link=1)
+        + causal_op(0, "read", 1, pos=3, link=2)
+        + causal_op(0, "write", 2, pos=4, link=3)
+        + causal_op(0, "read", 2, pos=5, link=4)
+    )
+    r = CausalChecker().check({}, h)
+    assert r["valid?"] is True
+    assert r["counter"] == 2
+
+
+def test_causal_broken_link():
+    h = History(
+        causal_op(0, "read-init", 0, pos=1, link="init")
+        + causal_op(0, "write", 1, pos=2, link=99)
+    )
+    r = CausalChecker().check({}, h)
+    assert r["valid?"] is False
+    assert "link" in r["error"]
+
+
+def test_causal_stale_read():
+    h = History(
+        causal_op(0, "read-init", 0, pos=1, link="init")
+        + causal_op(0, "write", 1, pos=2, link=1)
+        + causal_op(0, "read", 0, pos=3, link=2)  # reads stale 0
+    )
+    r = CausalChecker().check({}, h)
+    assert r["valid?"] is False
+
+
+def test_causal_reverse_violation():
+    # w1 ok strictly before w2 invoked; a read sees w2 without w1.
+    h = History([
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(1, "write", 2),
+        ok_op(1, "write", 2),
+        invoke_op(2, "read"),
+        ok_op(2, "read", [None, 2]),
+    ])
+    r = CausalReverseChecker().check({}, h)
+    assert r["valid?"] is False
+    assert r["errors"][0]["missing"] == [1]
+    # seeing both, or neither, is fine
+    h2 = History([
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(1, "write", 2),
+        ok_op(1, "write", 2),
+        invoke_op(2, "read"),
+        ok_op(2, "read", [1, 2]),
+    ])
+    assert CausalReverseChecker().check({}, h2)["valid?"] is True
+
+
+# -- independent keyed lifting -----------------------------------------------
+
+
+def test_kv_tuple_semantics():
+    a = independent.KV("x", 1)
+    assert a == independent.tuple_("x", 1)
+    assert tuple(a) == ("x", 1)
+    assert len({a, independent.KV("x", 1)}) == 1
+
+
+def test_independent_checker_splits_by_key():
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+
+    KV = independent.KV
+    h = History([
+        invoke_op(0, "write", KV("a", 1)),
+        ok_op(0, "write", KV("a", 1)),
+        invoke_op(1, "write", KV("b", 2)),
+        ok_op(1, "write", KV("b", 2)),
+        invoke_op(0, "read", KV("a", None)),
+        ok_op(0, "read", KV("a", 1)),
+        invoke_op(1, "read", KV("b", None)),
+        ok_op(1, "read", KV("b", 99)),  # bad read on key b only
+    ])
+    r = independent.independent_checker(
+        LinearizableChecker()
+    ).check({}, h)
+    assert r["valid?"] is False
+    assert r["results"]["a"]["valid?"] is True
+    assert r["results"]["b"]["valid?"] is False
+
+
+def test_sequential_generator_walks_keys():
+    from jepsen_tpu.generator.simulate import quick
+
+    from jepsen_tpu.generator import pure as gen
+
+    g = independent.sequential_generator(
+        ["k1", "k2"],
+        lambda k: [gen.once({"f": "read"}), gen.once({"f": "read"})],
+    )
+    ops = quick(g)
+    keys = [o["value"].key for o in ops]
+    assert keys == ["k1", "k1", "k2", "k2"]
+
+
+def test_concurrent_generator_groups_threads():
+    from jepsen_tpu.generator import pure as gen
+    from jepsen_tpu.generator.simulate import quick_ops
+
+    ctx = gen.context(
+        time=0, free_threads=(0, 1, 2, 3),
+        workers={0: 0, 1: 1, 2: 2, 3: 3},
+    )
+    g = independent.concurrent_generator(
+        2, ["a", "b", "c"],
+        lambda k: gen.limit(2, {"f": "read"}),
+    )
+    ops = [o for o in quick_ops(g, ctx=ctx) if o["type"] == "invoke"]
+    # 3 keys x 2 ops each
+    assert len(ops) == 6
+    by_key = {}
+    for o in ops:
+        by_key.setdefault(o["value"].key, set()).add(o["process"])
+    # group 0 (threads 0,1) serves keys a, c; group 1 (threads 2,3)
+    # serves key b
+    assert by_key["a"] <= {0, 1} and by_key["c"] <= {0, 1}
+    assert by_key["b"] <= {2, 3}
+
+
+def test_keyed_register_workload_end_to_end():
+    spec = register.keyed_workload(
+        keys=range(4), per_key_ops=20, threads_per_key=2,
+        rng=random.Random(5),
+    )
+    test = run({**spec, "concurrency": 4})
+    assert test["results"]["valid?"] is True
+    assert test["results"]["key_count"] == 4
