@@ -42,11 +42,7 @@ def _bank_reduce():
     return reduce
 
 
-def _bucket(n: int) -> int:
-    size = 64
-    while size < n:
-        size *= 2
-    return size
+from jepsen_tpu.checker.events import bucket as _bucket
 
 
 class BankChecker:

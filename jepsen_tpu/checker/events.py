@@ -50,6 +50,15 @@ NIL = -1
 MAX_WINDOW = 128
 
 
+def bucket(n: int, lo: int = 64) -> int:
+    """Power-of-two shape bucket >= n (one XLA/Mosaic compile per
+    bucket) — the single bucketing policy for every checker plane."""
+    size = lo
+    while size < n:
+        size *= 2
+    return size
+
+
 def n_words(W: int) -> int:
     """Mask words needed for a W-slot window (32 slots per int32)."""
     return max((W + 31) // 32, 1)

@@ -92,10 +92,9 @@ def _bucket_window(window: int) -> Optional[int]:
 
 
 def _bucket_events(n: int) -> int:
-    size = 64
-    while size < n:
-        size *= 2
-    return size
+    from jepsen_tpu.checker.events import bucket
+
+    return bucket(n, 64)
 
 
 def check_events_bucketed(

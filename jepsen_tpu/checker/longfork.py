@@ -49,11 +49,7 @@ def _fork_kernel():
     return forks
 
 
-def _bucket(n: int, lo: int = 8) -> int:
-    size = lo
-    while size < n:
-        size *= 2
-    return size
+from jepsen_tpu.checker.events import bucket as _bucket
 
 
 class LongForkChecker:

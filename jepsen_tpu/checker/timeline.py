@@ -1,0 +1,93 @@
+"""HTML timeline: per-process operation bars.
+
+Reference: jepsen/src/jepsen/checker/timeline.clj — pairs invocations
+with completions (:33-53) and renders one column per process with a
+div per op, colored by outcome (:97-121,159-179). Output is a single
+self-contained timeline.html in the run directory (when the test has
+one) or returned inline.
+"""
+
+from __future__ import annotations
+
+import html
+import os
+from typing import List, Optional
+
+_COLOR = {"ok": "#B3F3B5", "info": "#FFEB91", "fail": "#F7B5B5"}
+
+
+def render(test, history) -> str:
+    from jepsen_tpu.history.history import History
+
+    if not isinstance(history, History):
+        history = History(list(history))
+    pairs = history.pairs()
+    completions = {}
+    for op in history.ops:
+        if not op.is_invoke:
+            inv = pairs.get(op.index)
+            if inv is not None:
+                completions[inv] = op
+    procs: List = sorted(
+        {op.process for op in history.ops},
+        key=lambda p: (isinstance(p, str), str(p)),
+    )
+    col = {p: i for i, p in enumerate(procs)}
+    t_max = max((op.time for op in history.ops if op.time > 0), default=1)
+    scale = 600.0 / t_max  # px per nano
+
+    divs = []
+    for op in history.ops:
+        if not op.is_invoke:
+            continue
+        comp = completions.get(op.index)
+        t0 = max(op.time, 0)
+        t1 = comp.time if comp is not None and comp.time > 0 else t_max
+        outcome = comp.type if comp is not None else "info"
+        top = t0 * scale
+        height = max((t1 - t0) * scale, 8)
+        left = col[op.process] * 160
+        val = comp.value if comp is not None and comp.is_ok else op.value
+        label = f"{op.process} {op.f} {val!r}"
+        divs.append(
+            f'<div class="op" style="top:{top:.1f}px;left:{left}px;'
+            f'height:{height:.1f}px;background:{_COLOR.get(outcome, "#ddd")}"'
+            f' title="{html.escape(label)} [{outcome}]">'
+            f"{html.escape(str(op.f))} {html.escape(repr(val))}</div>"
+        )
+    heads = "".join(
+        f'<div class="head" style="left:{col[p] * 160}px">'
+        f"{html.escape(str(p))}</div>"
+        for p in procs
+    )
+    return (
+        "<html><head><style>"
+        ".op{position:absolute;width:150px;font-size:10px;"
+        "border:1px solid #888;overflow:hidden;margin-top:24px}"
+        ".head{position:absolute;top:0;width:150px;font-weight:bold}"
+        "body{font-family:sans-serif;position:relative}"
+        "</style></head><body>"
+        f"<h3>{html.escape(str(test.get('name', 'timeline')))}</h3>"
+        f'<div style="position:relative">{heads}{"".join(divs)}</div>'
+        "</body></html>"
+    )
+
+
+class TimelineChecker:
+    """Checker-protocol adapter: renders timeline.html into the test's
+    run_dir (timeline.clj:159-179); always valid."""
+
+    def check(self, test, history, opts=None) -> dict:
+        doc = render(test, history)
+        out: Optional[str] = None
+        run_dir = (opts or {}).get("subdirectory") or test.get("run_dir")
+        if run_dir:
+            os.makedirs(run_dir, exist_ok=True)
+            out = os.path.join(run_dir, "timeline.html")
+            with open(out, "w") as f:
+                f.write(doc)
+        return {"valid?": True, "file": out}
+
+
+def html_timeline() -> TimelineChecker:
+    return TimelineChecker()

@@ -400,10 +400,9 @@ def check_keys_pallas(
     # Power-of-two bucket (not just a STEP_BLOCK multiple): one Mosaic
     # compile serves every batch length in the bucket, like the
     # single-key path.
-    bucket = 64
-    while bucket < n:
-        bucket *= 2
-    n = bucket
+    from jepsen_tpu.checker.events import bucket
+
+    n = bucket(n, 64)
     name = model if isinstance(model, str) else model.name
     wins, metas = [], []
     for st in steps_list:

@@ -1,0 +1,244 @@
+"""Command-line interface: test / analyze / serve.
+
+Reference: jepsen/src/jepsen/cli.clj — shared option spec (:54-92),
+"3n" concurrency parsing (:130-145), subcommand dispatch with exit
+codes (:229-304: 0 valid, 1 invalid, 2 unknown, 254 crash, 255 usage),
+single-test-cmd's paired `test` + `analyze` commands (:323-397 — the
+decoupled analyze seam is exactly where the TPU checker plugs in), and
+serve-cmd (:306-321).
+
+    python -m jepsen_tpu.cli test --workload bank --time-limit 10
+    python -m jepsen_tpu.cli analyze store/bank/latest --workload bank
+    python -m jepsen_tpu.cli serve --port 8080
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import traceback
+from typing import Any, Dict, List, Optional
+
+EXIT_VALID = 0
+EXIT_INVALID = 1
+EXIT_UNKNOWN = 2
+EXIT_CRASH = 254
+EXIT_USAGE = 255
+
+WORKLOADS = ("register", "register-keyed", "bank", "long-fork", "g2")
+
+
+def parse_concurrency(spec: str, n_nodes: int) -> int:
+    """Parse "5" or "3n" (n = node count) — cli.clj:130-145."""
+    spec = str(spec).strip()
+    if spec.endswith("n"):
+        return int(spec[:-1] or 1) * n_nodes
+    return int(spec)
+
+
+def parse_nodes(args) -> List[str]:
+    if args.nodes_file:
+        with open(args.nodes_file) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+    return [n.strip() for n in args.nodes.split(",") if n.strip()]
+
+
+def _workload_spec(args, rng: random.Random) -> Dict[str, Any]:
+    from jepsen_tpu.workloads import adya, bank, long_fork, register
+
+    name = args.workload
+    if name == "register":
+        return register.workload(n_ops=args.ops, rng=rng)
+    if name == "register-keyed":
+        return register.keyed_workload(
+            keys=range(args.keys), per_key_ops=max(args.ops // args.keys, 1),
+            rng=rng,
+        )
+    if name == "bank":
+        return bank.workload(n_ops=args.ops, rng=rng)
+    if name == "long-fork":
+        return long_fork.workload(n_ops=args.ops, rng=rng)
+    if name == "g2":
+        return adya.workload(n_keys=max(args.ops // 2, 1))
+    raise ValueError(f"unknown workload {name!r}")
+
+
+def _checker_for(workload: str):
+    from jepsen_tpu import independent
+    from jepsen_tpu.checker.adya import G2Checker
+    from jepsen_tpu.checker.bank import BankChecker
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+    from jepsen_tpu.checker.longfork import LongForkChecker
+    from jepsen_tpu.workloads.adya import _KVG2Checker
+
+    return {
+        "register": LinearizableChecker(),
+        "register-keyed": independent.independent_checker(
+            LinearizableChecker()
+        ),
+        "bank": BankChecker(),
+        "long-fork": LongForkChecker(2),
+        "g2": _KVG2Checker(),
+    }[workload]
+
+
+def _exit_code(results: Optional[dict]) -> int:
+    if results is None:
+        return EXIT_UNKNOWN
+    v = results.get("valid?")
+    if v is True:
+        return EXIT_VALID
+    if v is False:
+        return EXIT_INVALID
+    return EXIT_UNKNOWN  # "unknown" verdicts (cli.clj:272-283)
+
+
+def cmd_test(args) -> int:
+    from jepsen_tpu import store as storelib
+    from jepsen_tpu.generator import pure as gen
+    from jepsen_tpu.runtime import run
+
+    rng = random.Random(args.seed)
+    nodes = parse_nodes(args)
+    worst = EXIT_VALID
+    for i in range(args.test_count):
+        spec = _workload_spec(args, rng)
+        if args.time_limit:
+            g = spec["generator"]
+            spec["generator"] = gen.time_limit(args.time_limit, g)
+        concurrency = parse_concurrency(args.concurrency, len(nodes))
+        if args.workload == "register-keyed":
+            # concurrent_generator needs a thread-group multiple.
+            concurrency += (-concurrency) % 2
+        test = {
+            **spec,
+            "name": args.name or args.workload,
+            "nodes": nodes,
+            "store": args.store,
+            "concurrency": concurrency,
+        }
+        test = run(test)
+        d = test["run_dir"]
+        results = test["results"]
+        print(f"run {i + 1}/{args.test_count}: "
+              f"valid?={results.get('valid?')}  ({d})")
+        worst = max(worst, _exit_code(results))
+        if worst != EXIT_VALID and args.until_failure:
+            break
+    print(_epitaph(worst))
+    return worst
+
+
+def _resolve_run_dir(path: str, store_root: str) -> str:
+    import os
+
+    if os.path.isdir(path) and os.path.exists(
+        os.path.join(path, "history.jsonl")
+    ):
+        return path
+    # maybe a test name: use its latest run
+    from jepsen_tpu.store import Store
+
+    latest = Store(store_root).latest(path if path else None)
+    if latest is None:
+        raise FileNotFoundError(f"no stored run at {path!r}")
+    return latest
+
+
+def cmd_analyze(args) -> int:
+    """Re-check a stored history — the checkpoint/resume seam for the
+    analysis phase (cli.clj:366-397)."""
+    from jepsen_tpu.store import Store
+
+    run_dir = _resolve_run_dir(args.path, args.store)
+    st = Store(args.store)
+    history = st.load_history(run_dir)
+    test = st.load_test(run_dir)
+    checker = _checker_for(args.workload)
+    results = checker.check(test, history, {})
+    test["results"] = results
+    test["run_dir"] = run_dir
+    st.save_2(test)
+    print(f"analyzed {run_dir}: valid?={results.get('valid?')}")
+    print(_epitaph(_exit_code(results)))
+    return _exit_code(results)
+
+
+def cmd_serve(args) -> int:
+    from jepsen_tpu.web import serve
+
+    serve(root=args.store, port=args.port)
+    return EXIT_VALID
+
+
+def _epitaph(code: int) -> str:
+    """Results one-liner (core.clj:453-465's celebratory/despair)."""
+    if code == EXIT_VALID:
+        return "Everything looks good! (code 0)"
+    if code == EXIT_INVALID:
+        return "Analysis invalid! (code 1)"
+    return "Errors occurred during analysis; verdict unknown. (code 2)"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="jepsen_tpu",
+        description="TPU-native distributed-systems correctness testing",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def shared(sp):
+        sp.add_argument("--nodes", default="n1,n2,n3,n4,n5",
+                        help="comma-separated node names")
+        sp.add_argument("--nodes-file", default=None)
+        sp.add_argument("--store", default="store",
+                        help="store root directory")
+        sp.add_argument("--workload", choices=WORKLOADS,
+                        default="register")
+
+    t = sub.add_parser("test", help="run a test and analyze it")
+    shared(t)
+    t.add_argument("--name", default=None)
+    t.add_argument("--concurrency", default="1n",
+                   help="worker count; '3n' = 3 per node")
+    t.add_argument("--time-limit", type=float, default=None,
+                   help="seconds of op generation")
+    t.add_argument("--ops", type=int, default=500,
+                   help="op budget for the workload generator")
+    t.add_argument("--keys", type=int, default=8)
+    t.add_argument("--test-count", type=int, default=1)
+    t.add_argument("--until-failure", action="store_true")
+    t.add_argument("--seed", type=int, default=None)
+    t.set_defaults(fn=cmd_test)
+
+    a = sub.add_parser(
+        "analyze", help="re-check a stored history (no cluster needed)"
+    )
+    shared(a)
+    a.add_argument("path", nargs="?", default="",
+                   help="run directory or test name (default: latest)")
+    a.set_defaults(fn=cmd_analyze)
+
+    s = sub.add_parser("serve", help="web dashboard over the store")
+    shared(s)
+    s.add_argument("--port", type=int, default=8080)
+    s.set_defaults(fn=cmd_serve)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = build_parser()
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return EXIT_USAGE if e.code not in (0, None) else 0
+    try:
+        return args.fn(args)
+    except Exception:
+        traceback.print_exc()
+        return EXIT_CRASH
+
+
+if __name__ == "__main__":
+    sys.exit(main())

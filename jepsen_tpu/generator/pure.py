@@ -790,6 +790,66 @@ def delay_til(dt_seconds, gen) -> DelayTil:
     return DelayTil(int(dt_seconds * 1e9), None, gen)
 
 
+class Sleep(Generator):
+    """Emits nothing for dt (then exhausts) — the piece v2 left
+    unfinished (pure.clj:790-802). Anchors to the context time of its
+    first poll; interpreters must commit the successor generator on
+    PENDING results for the anchor to stick (the scheduler and the
+    simulation harness both do)."""
+
+    def __init__(self, dt_nanos, until=None):
+        self.dt_nanos = dt_nanos
+        self.until = until
+
+    def op(self, test, ctx):
+        until = (
+            self.until if self.until is not None
+            else ctx["time"] + self.dt_nanos
+        )
+        if ctx["time"] >= until:
+            return None
+        return (PENDING, Sleep(self.dt_nanos, until))
+
+    def update(self, test, ctx, event):
+        return self
+
+
+def sleep(dt_seconds) -> Sleep:
+    return Sleep(int(dt_seconds * 1e9))
+
+
+class Repeat(Generator):
+    """Cycles a generator factory forever: when the current instance
+    exhausts, a fresh one is built — (cycle [...]) in reference suites
+    (e.g. the partition nemesis rhythm, etcd.clj:172-176)."""
+
+    def __init__(self, factory: Callable[[], Any], current=None):
+        self.factory = factory
+        self.current = current
+
+    def op(self, test, ctx):
+        current = self.current if self.current is not None \
+            else self.factory()
+        for _ in range(2):  # one refresh attempt per poll
+            pair = op(current, test, ctx)
+            if pair is not None:
+                o, g2 = pair
+                return o, Repeat(self.factory, g2)
+            current = self.factory()
+        return (PENDING, Repeat(self.factory, current))
+
+    def update(self, test, ctx, event):
+        if self.current is None:
+            return self
+        return Repeat(
+            self.factory, update(self.current, test, ctx, event)
+        )
+
+
+def repeat(factory) -> Repeat:
+    return Repeat(factory)
+
+
 # -- barriers: synchronize / phases / then (pure.clj:805-843) ----------------
 
 

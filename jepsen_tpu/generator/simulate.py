@@ -107,7 +107,10 @@ def simulate(
             ops.append(invoke)
         else:
             # Must complete something first. NOTE: g2 is discarded — the
-            # invocation wasn't consumed.
+            # invocation wasn't consumed (reference semantics,
+            # pure_test.clj:57-105). Sleep-style generators therefore
+            # only anchor correctly under the real-time scheduler, which
+            # commits PENDING successors.
             assert in_flight, "generator pending and nothing in flight"
             o = in_flight[0]
             thread = gen.process_to_thread(ctx, o["process"])

@@ -127,6 +127,11 @@ class ConcurrentGenerator(gen.Generator):
         client_threads = [
             t for t in gen.all_threads(ctx) if not isinstance(t, str)
         ]
+        if len(client_threads) % self.n:
+            raise ValueError(
+                f"concurrency ({len(client_threads)} client threads) "
+                f"must be a multiple of the group size {self.n}"
+            )
         n_groups = max(len(client_threads) // self.n, 1)
         return {
             "groups": {
@@ -233,16 +238,24 @@ class IndependentChecker:
                 op.with_(value=v.value)
             )
         results = {}
-        valid = True
+        any_false = any_unknown = False
         for k, ops in sorted(
             subhistories.items(), key=lambda kv: str(kv[0])
         ):
             r = self.checker.check(test, History(ops), opts)
             results[k] = r
-            if r.get("valid?") is not True:
-                valid = r.get("valid?", False)
+            v = r.get("valid?")
+            if v is False:
+                any_false = True
+            elif v is not True:
+                any_unknown = True
+        # Merge lattice: False dominates unknown dominates True
+        # (checker.clj:26-69's merge-valid).
+        valid = (
+            False if any_false else ("unknown" if any_unknown else True)
+        )
         return {
-            "valid?": valid if subhistories else True,
+            "valid?": valid,
             "key_count": len(subhistories),
             "results": results,
         }

@@ -176,14 +176,24 @@ def partition_majorities_ring(rng=None) -> Partitioner:
 
 
 class Compose(Nemesis):
-    """Routes ops to child nemeses by f. Keys are either sets of fs
-    (routed unchanged) or {outer-f: inner-f} dicts (translated)."""
+    """Routes ops to child nemeses by f. Routing specs are either
+    collections of fs (routed unchanged) or {outer-f: inner-f} mappings
+    (translated). Accepts a dict (hashable keys) or an iterable of
+    (routing, nemesis) pairs — plain sets/dicts work as routings in
+    pair form, where hashability doesn't matter."""
 
-    def __init__(self, nemeses: Dict[Any, Nemesis]):
-        self.nemeses = dict(nemeses)
+    def __init__(self, nemeses):
+        if isinstance(nemeses, dict):
+            pairs = list(nemeses.items())
+        else:
+            pairs = [tuple(p) for p in nemeses]
+        self.routes = [
+            (dict(fs) if isinstance(fs, dict) else set(fs), nem)
+            for fs, nem in pairs
+        ]
 
     def _route(self, f):
-        for fs, nem in self.nemeses.items():
+        for fs, nem in self.routes:
             if isinstance(fs, dict):
                 if f in fs:
                     return fs[f], nem
@@ -192,9 +202,9 @@ class Compose(Nemesis):
         return None
 
     def setup(self, test) -> "Compose":
-        self.nemeses = {
-            fs: nem.setup(test) for fs, nem in self.nemeses.items()
-        }
+        self.routes = [
+            (fs, nem.setup(test)) for fs, nem in self.routes
+        ]
         return self
 
     def invoke(self, test, op: Op) -> Op:
@@ -206,11 +216,11 @@ class Compose(Nemesis):
         return out.with_(f=op.f)
 
     def teardown(self, test) -> None:
-        for nem in self.nemeses.values():
+        for _, nem in self.routes:
             nem.teardown(test)
 
 
-def compose(nemeses: Dict[Any, Nemesis]) -> Compose:
+def compose(nemeses) -> Compose:
     return Compose(nemeses)
 
 

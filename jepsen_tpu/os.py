@@ -1,0 +1,73 @@
+"""OS automation: prepare nodes before the DB installs.
+
+Reference: jepsen/src/jepsen/os.clj (2-method protocol) and
+os/debian.clj (package install, hostfile fix, base tooling
+:13-174). The debian implementation here covers the base-setup subset
+the fault plane needs (iptables/tc/ntp tooling present, hosts file
+mapping test nodes); package installation is idempotent.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable
+
+from jepsen_tpu.control.core import RemoteError, Session
+
+
+class OS:
+    """Protocol (os.clj:4-8)."""
+
+    def setup(self, test, node: str, session: Session) -> None:
+        pass
+
+    def teardown(self, test, node: str, session: Session) -> None:
+        pass
+
+
+noop = OS
+
+
+class Debian(OS):
+    """Debian-family setup (os/debian.clj:139-174): install the base
+    packages the nemeses rely on and pin the hosts file so test node
+    names resolve."""
+
+    BASE_PACKAGES = (
+        "curl", "faketime", "iptables", "psmisc", "tar", "unzip",
+        "iputils-ping", "iproute2", "logrotate",
+    )
+
+    def __init__(self, extra_packages: Iterable[str] = ()):
+        self.packages = list(self.BASE_PACKAGES) + list(extra_packages)
+
+    def installed(self, session: Session, pkgs) -> Dict[str, bool]:
+        out = session.exec(
+            "dpkg-query", "-W", "-f", "${Package}\\n", *pkgs, check=False
+        )
+        have = set(out.split())
+        return {p: p in have for p in pkgs}
+
+    def setup(self, test, node: str, session: Session) -> None:
+        missing = [
+            p for p, ok in self.installed(session, self.packages).items()
+            if not ok
+        ]
+        if missing:
+            session.exec(
+                "env", "DEBIAN_FRONTEND=noninteractive",
+                "apt-get", "install", "-y", *missing, sudo=True,
+            )
+        self.setup_hostfile(test, node, session)
+
+    def setup_hostfile(self, test, node: str, session: Session) -> None:
+        """Map every test node name in /etc/hosts
+        (os/debian.clj's hostfile fix)."""
+        lines = ["127.0.0.1 localhost"]
+        for i, n in enumerate(test.get("nodes", [])):
+            ip = test.get("node_ips", {}).get(n)
+            if ip:
+                lines.append(f"{ip} {n}")
+        content = "\n".join(lines) + "\n"
+        session.exec(
+            "sh", "-c", "cat > /etc/hosts", sudo=True, stdin=content
+        )

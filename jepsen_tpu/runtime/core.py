@@ -104,7 +104,11 @@ class Scheduler:
                 if pair is None:
                     return None
                 o, g2 = pair
-                if o is not gen.PENDING:
+                if o is gen.PENDING:
+                    # Commit the successor even for PENDING: Sleep-style
+                    # generators anchor their deadline in it.
+                    self._gen = g2
+                else:
                     # Is this op for us? Ops carry a process; map it to
                     # its thread. Workers only execute their own ops —
                     # another thread's op stays uncommitted for its
@@ -380,6 +384,19 @@ def run(test: Dict[str, Any]) -> Dict[str, Any]:
     sched = Scheduler(test.get("generator"), test, threads, t0)
     rec = _HistoryRecorder()
 
+    # Environment lifecycle (core.clj:538-552): OS setup on every node,
+    # then the DB teardown/setup cycle (with retries), before any
+    # worker runs. Only engaged when the spec carries the slots.
+    from jepsen_tpu.control.core import on_nodes as _on_nodes
+
+    os_ = test.get("os")
+    if os_ is not None:
+        _on_nodes(test, lambda nd, s: os_.setup(test, nd, s))
+    if test.get("db") is not None:
+        from jepsen_tpu import db as _dblib
+
+        _dblib.cycle(test)
+
     # Nemesis lifecycle (nemesis.clj:9-14): setup before workers spawn,
     # teardown after they drain.
     nem = test.get("nemesis")
@@ -412,6 +429,15 @@ def run(test: Dict[str, Any]) -> Dict[str, Any]:
                     "persist: %s", e
                 )
                 test["nemesis_teardown_error"] = f"{type(e).__name__}: {e}"
+        db = test.get("db")
+        if db is not None:
+            def _td(nd, s):
+                try:
+                    db.teardown(test, nd, s)
+                except Exception:
+                    pass
+
+            _on_nodes(test, _td)
 
 
     if sched.poisoned is not None:
@@ -422,9 +448,27 @@ def run(test: Dict[str, Any]) -> Dict[str, Any]:
 
     history = History(rec.snapshot())
     test["history"] = history
+
+    # Two-phase persistence around analysis (store.clj:367-392): when
+    # the spec carries a store root, the run directory + history are
+    # saved BEFORE checking (so artifact-writing checkers like the
+    # timeline have a home, and a checker crash still leaves the
+    # history on disk), results after.
+    store = None
+    if test.get("store") is not None:
+        from jepsen_tpu.store import Store
+
+        store = (
+            test["store"] if isinstance(test["store"], Store)
+            else Store(str(test["store"]))
+        )
+        store.save_1(test)
+
     checker = test.get("checker")
     if checker is not None:
         test["results"] = checker.check(test, history, {})
     else:
         test["results"] = {"valid?": True}
+    if store is not None:
+        store.save_2(test)
     return test

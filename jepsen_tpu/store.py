@@ -1,0 +1,237 @@
+"""Persistence: run directories, history serialization, symlinks.
+
+Reference: jepsen/src/jepsen/store.clj — layout
+store/<name>/<start-time>/ (:26,125-147), two-phase save (history
+before analysis, results after, :367-392), load/load-results/latest
+(:177-300), current/latest symlink maintenance (:302-328), and
+non-serializable slot stripping (:167-175).
+
+Format departures (tpu-first, tooling-friendly): histories serialize as
+JSON Lines (one op per line — append-friendly, streamable, and loadable
+straight into the columnar plane), test/results as JSON. Fressian's
+custom type handlers become a small tag scheme (__kv__ for independent
+tuples, __tuple__ for tuples, __set__ for sets).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time as _time
+from typing import Any, Dict, Iterable, List, Optional
+
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.ops import Op
+
+DEFAULT_ROOT = "store"
+
+#: test-map slots that are protocol objects / runtime state — never
+#: serialized (store.clj:167-175's nonserializable-keys)
+STRIP_KEYS = (
+    "client", "nemesis", "checker", "generator", "db", "os", "net",
+    "remote", "history", "results", "_sessions", "_ip_cache",
+)
+
+
+def _encode_value(v):
+    from jepsen_tpu.independent import KV
+
+    if isinstance(v, KV):
+        return {"__kv__": [_encode_value(v.key), _encode_value(v.value)]}
+    if isinstance(v, tuple):
+        return {"__tuple__": [_encode_value(x) for x in v]}
+    if isinstance(v, (set, frozenset)):
+        # Sort by canonical JSON so mixed-type / tuple elements don't
+        # raise on comparison.
+        return {
+            "__set__": sorted(
+                (_encode_value(x) for x in v),
+                key=lambda e: json.dumps(e, sort_keys=True, default=str),
+            )
+        }
+    if isinstance(v, dict):
+        if all(isinstance(k, str) for k in v):
+            return {k: _encode_value(x) for k, x in v.items()}
+        # Non-string keys (account ids, key numbers): JSON would
+        # stringify them, so keep them as tagged pairs.
+        return {
+            "__dict__": [
+                [_encode_value(k), _encode_value(x)] for k, x in v.items()
+            ]
+        }
+    if isinstance(v, (list,)):
+        return [_encode_value(x) for x in v]
+    return v
+
+
+def _decode_value(v):
+    from jepsen_tpu.independent import KV
+
+    if isinstance(v, dict):
+        if set(v) == {"__kv__"}:
+            k, val = v["__kv__"]
+            return KV(_decode_value(k), _decode_value(val))
+        if set(v) == {"__tuple__"}:
+            return tuple(_decode_value(x) for x in v["__tuple__"])
+        if set(v) == {"__set__"}:
+            return set(_decode_value(x) for x in v["__set__"])
+        if set(v) == {"__dict__"}:
+            return {
+                _decode_value(k): _decode_value(x)
+                for k, x in v["__dict__"]
+            }
+        return {k: _decode_value(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode_value(x) for x in v]
+    return v
+
+
+def op_to_json(op: Op) -> dict:
+    d = {
+        "type": op.type,
+        "f": op.f,
+        "value": _encode_value(op.value),
+        "process": op.process,
+        "time": op.time,
+        "index": op.index,
+    }
+    if op.error is not None:
+        d["error"] = op.error
+    if op.extra:
+        d["extra"] = _encode_value(op.extra)
+    return d
+
+
+def op_from_json(d: dict) -> Op:
+    return Op(
+        type=d["type"],
+        f=d.get("f"),
+        value=_decode_value(d.get("value")),
+        process=d.get("process"),
+        time=d.get("time", -1),
+        index=d.get("index", -1),
+        error=d.get("error"),
+        extra=_decode_value(d.get("extra") or {}),
+    )
+
+
+class Store:
+    """A run-directory store rooted at `root` (default ./store)."""
+
+    def __init__(self, root: str = DEFAULT_ROOT):
+        self.root = root
+
+    # -- paths (store.clj:125-147) ---------------------------------------
+
+    def path(self, name: str, stamp: str) -> str:
+        return os.path.join(self.root, name, stamp)
+
+    def make_run_dir(self, test: Dict[str, Any]) -> str:
+        name = test.get("name", "noname")
+        start = test.get("start_time", _time.time())
+        stamp = _time.strftime(
+            "%Y%m%dT%H%M%S", _time.localtime(start)
+        ) + f".{int(start * 1000) % 1000:03d}"
+        d = self.path(name, stamp)
+        os.makedirs(d, exist_ok=True)
+        self._symlink(os.path.join(self.root, name, "latest"), stamp)
+        self._symlink(
+            os.path.join(self.root, "current"), os.path.join(name, stamp)
+        )
+        test["run_dir"] = d
+        return d
+
+    @staticmethod
+    def _symlink(link: str, target: str) -> None:
+        try:
+            if os.path.islink(link):
+                os.unlink(link)
+            os.symlink(target, link)
+        except OSError:  # filesystems without symlink support
+            pass
+
+    # -- two-phase save (store.clj:367-392) -------------------------------
+
+    def save_1(self, test: Dict[str, Any]) -> str:
+        """Phase 1, before analysis: test map (stripped) + history."""
+        d = test.get("run_dir") or self.make_run_dir(test)
+        clean = {
+            k: v for k, v in test.items()
+            if k not in STRIP_KEYS and not k.startswith("_")
+        }
+        with open(os.path.join(d, "test.json"), "w") as f:
+            json.dump(_encode_value(clean), f, indent=2, default=str)
+        history: Optional[History] = test.get("history")
+        if history is not None:
+            with open(os.path.join(d, "history.jsonl"), "w") as f:
+                for op in history.ops:
+                    f.write(json.dumps(op_to_json(op), default=str))
+                    f.write("\n")
+        return d
+
+    def save_2(self, test: Dict[str, Any]) -> str:
+        """Phase 2, after analysis: results."""
+        d = test.get("run_dir") or self.make_run_dir(test)
+        with open(os.path.join(d, "results.json"), "w") as f:
+            json.dump(_encode_value(test.get("results")), f, indent=2,
+                      default=str)
+        return d
+
+    # -- load (store.clj:177-300) -----------------------------------------
+
+    def load_history(self, run_dir: str) -> History:
+        ops: List[Op] = []
+        with open(os.path.join(run_dir, "history.jsonl")) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    ops.append(op_from_json(json.loads(line)))
+        return History(ops, indexed=True)
+
+    def load_test(self, run_dir: str) -> dict:
+        with open(os.path.join(run_dir, "test.json")) as f:
+            return _decode_value(json.load(f))
+
+    def load_results(self, run_dir: str) -> Optional[dict]:
+        p = os.path.join(run_dir, "results.json")
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            return _decode_value(json.load(f))
+
+    def tests(self, name: Optional[str] = None) -> Dict[str, List[str]]:
+        """{test-name: [stamps...]} of stored runs."""
+        out: Dict[str, List[str]] = {}
+        if not os.path.isdir(self.root):
+            return out
+        names = [name] if name else sorted(os.listdir(self.root))
+        for n in names:
+            d = os.path.join(self.root, n)
+            if not os.path.isdir(d) or n == "current":
+                continue
+            stamps = sorted(
+                s for s in os.listdir(d)
+                if s != "latest" and os.path.isdir(os.path.join(d, s))
+            )
+            if stamps:
+                out[n] = stamps
+        return out
+
+    def latest(self, name: Optional[str] = None) -> Optional[str]:
+        """Path of the most recent run (for `name`, or overall)."""
+        ts = self.tests(name)
+        best = None
+        for n, stamps in ts.items():
+            cand = (stamps[-1], n)
+            if best is None or cand[0] > best[0]:
+                best = cand
+        if best is None:
+            return None
+        return self.path(best[1], best[0])
+
+
+def save_run(test: Dict[str, Any], root: str = DEFAULT_ROOT) -> str:
+    """Both save phases for a completed, analyzed test."""
+    st = Store(root)
+    st.save_1(test)
+    return st.save_2(test)

@@ -1,0 +1,12 @@
+"""Per-database test suites.
+
+The analog of the reference's ~27 per-database Leiningen projects
+(etcd/, zookeeper/, tidb/, ... — SURVEY.md §2.5). Each suite module
+exposes `<name>_test(opts) -> test map` plus a `main()` wired to the
+shared CLI, following the canonical 197-line etcd shape
+(etcd/src/jepsen/etcd.clj:149-188).
+"""
+
+from jepsen_tpu.suites import etcd, zookeeper
+
+__all__ = ["etcd", "zookeeper"]

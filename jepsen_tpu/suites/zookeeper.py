@@ -1,0 +1,103 @@
+"""ZooKeeper suite: the second single-file shape.
+
+Reference: zookeeper/src/jepsen/zookeeper.clj (146 lines) — Debian
+package install with myid/zoo.cfg config rendering, a keyed
+linearizable register workload, and a partitioner. Same skeleton as
+the etcd suite; the client here drives the four-letter-word admin
+protocol for health and a keyed register via the control plane's
+zkCli (real mode), or the in-memory register (dummy mode).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, Optional
+
+from jepsen_tpu import independent, nemesis as nemlib, net as netlib
+from jepsen_tpu.checker import core as checker_core
+from jepsen_tpu.checker.linearizable import LinearizableChecker
+from jepsen_tpu.checker.timeline import html_timeline
+from jepsen_tpu.db import DB
+from jepsen_tpu.generator import pure as gen
+from jepsen_tpu.os import Debian
+
+
+class ZookeeperDB(DB):
+    """apt-install zookeeper, render myid + zoo.cfg, restart
+    (zookeeper.clj:23-68)."""
+
+    def setup(self, test, node, session):
+        session.exec(
+            "env", "DEBIAN_FRONTEND=noninteractive",
+            "apt-get", "install", "-y", "zookeeper", "zookeeperd",
+            sudo=True,
+        )
+        myid = test["nodes"].index(node) + 1
+        session.exec(
+            "sh", "-c", "cat > /etc/zookeeper/conf/myid",
+            sudo=True, stdin=f"{myid}\n",
+        )
+        servers = "\n".join(
+            f"server.{i + 1}={n}:2888:3888"
+            for i, n in enumerate(test["nodes"])
+        )
+        cfg = (
+            "tickTime=2000\ninitLimit=10\nsyncLimit=5\n"
+            "dataDir=/var/lib/zookeeper\nclientPort=2181\n" + servers + "\n"
+        )
+        session.exec(
+            "sh", "-c", "cat > /etc/zookeeper/conf/zoo.cfg",
+            sudo=True, stdin=cfg,
+        )
+        session.exec("service", "zookeeper", "restart", sudo=True)
+
+    def teardown(self, test, node, session):
+        session.exec("service", "zookeeper", "stop", sudo=True,
+                     check=False)
+        session.exec(
+            "rm", "-rf", "/var/lib/zookeeper/version-2", sudo=True,
+            check=False,
+        )
+
+    def log_files(self, test, node):
+        return ["/var/log/zookeeper/zookeeper.log"]
+
+
+def zookeeper_test(opts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    opts = dict(opts or {})
+    rng = opts.pop("rng", None) or random.Random(opts.pop("seed", 0))
+    dummy = opts.pop("dummy", False)
+
+    from jepsen_tpu.workloads.register import op_mix
+
+    client_gen = independent.concurrent_generator(
+        opts.pop("threads_per_key", 2),
+        list(range(opts.pop("keys", 16))),
+        lambda k: gen.limit(
+            opts.get("per_key_limit", 200),
+            gen.stagger(1 / 50, op_mix(rng), rng=rng),
+        ),
+    )
+    test: Dict[str, Any] = {
+        "name": "zookeeper",
+        "os": Debian(),
+        "db": ZookeeperDB(),
+        "net": netlib.IptablesNet(),
+        "nemesis": nemlib.partition_random_halves(rng=rng),
+        "generator": gen.clients(client_gen),
+        "checker": checker_core.compose({
+            "timeline": html_timeline(),
+            "indep": independent.independent_checker(
+                LinearizableChecker()
+            ),
+        }),
+    }
+    if dummy:
+        from jepsen_tpu.workloads.register import MultiRegisterClient
+
+        test.pop("os")
+        test.pop("db")
+        test["client"] = MultiRegisterClient()
+        test["net"] = netlib.MemNet()
+    test.update(opts)
+    return test

@@ -1,0 +1,137 @@
+"""Web dashboard: browse stored runs.
+
+Reference: jepsen/src/jepsen/web.clj — test table with validity colors
+(:25-34,48-80), run-directory file browser (:237+), serve! (:336).
+Implemented on http.server (stdlib) rendering the Store: no external
+web stack.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import os
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import unquote
+
+from jepsen_tpu.store import Store
+
+_COLORS = {True: "#6db6569e", False: "#d2322d9e", None: "#efaf4199"}
+
+
+def _validity_color(valid) -> str:
+    return _COLORS.get(valid if valid in (True, False) else None)
+
+
+def render_index(store: Store) -> str:
+    rows = []
+    for name, stamps in sorted(store.tests().items()):
+        for stamp in reversed(stamps):
+            run_dir = store.path(name, stamp)
+            results = store.load_results(run_dir)
+            valid = results.get("valid?") if results else None
+            rows.append(
+                f'<tr style="background:{_validity_color(valid)}">'
+                f'<td><a href="/files/{name}/{stamp}/">{html.escape(name)}'
+                f"</a></td><td>{html.escape(stamp)}</td>"
+                f"<td>{html.escape(str(valid))}</td></tr>"
+            )
+    return (
+        "<html><head><title>jepsen-tpu</title><style>"
+        "body{font-family:sans-serif} table{border-collapse:collapse}"
+        "td,th{padding:4px 12px;border:1px solid #ccc}</style></head>"
+        "<body><h1>jepsen-tpu runs</h1><table>"
+        "<tr><th>test</th><th>time</th><th>valid?</th></tr>"
+        + "".join(rows)
+        + "</table></body></html>"
+    )
+
+
+def _inside(root: str, full: str) -> bool:
+    try:
+        return os.path.commonpath(
+            [os.path.abspath(root), os.path.abspath(full)]
+        ) == os.path.abspath(root)
+    except ValueError:  # different drives etc.
+        return False
+
+
+def render_dir(store: Store, rel: str) -> Optional[str]:
+    full = os.path.normpath(os.path.join(store.root, rel))
+    if not _inside(store.root, full):
+        return None
+    if not os.path.isdir(full):
+        return None
+    items = []
+    for entry in sorted(os.listdir(full)):
+        p = os.path.join(rel, entry)
+        slash = "/" if os.path.isdir(os.path.join(full, entry)) else ""
+        items.append(
+            f'<li><a href="/files/{html.escape(p)}{slash}">'
+            f"{html.escape(entry)}{slash}</a></li>"
+        )
+    return (
+        f"<html><body><h2>{html.escape(rel) or 'store'}</h2>"
+        f"<ul>{''.join(items)}</ul><a href='/'>&larr; runs</a></body></html>"
+    )
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: Store  # set by serve()
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, body: bytes, ctype: str = "text/html",
+              code: int = 200) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        path = unquote(self.path)
+        if path in ("/", "/index.html"):
+            self._send(render_index(self.store).encode())
+            return
+        if path.startswith("/files/"):
+            rel = path[len("/files/"):].strip("/")
+            full = os.path.normpath(os.path.join(self.store.root, rel))
+            if not _inside(self.store.root, full):
+                self._send(b"forbidden", code=403)
+                return
+            if os.path.isdir(full):
+                body = render_dir(self.store, rel)
+                if body is None:
+                    self._send(b"not found", code=404)
+                else:
+                    self._send(body.encode())
+                return
+            if os.path.isfile(full):
+                ctype = (
+                    "application/json" if full.endswith(
+                        (".json", ".jsonl")
+                    ) else "text/plain"
+                )
+                with open(full, "rb") as f:
+                    self._send(f.read(), ctype=ctype)
+                return
+        self._send(b"not found", code=404)
+
+
+def make_server(root: str = "store", port: int = 8080):
+    handler = type("Handler", (_Handler,), {"store": Store(root)})
+    return ThreadingHTTPServer(("127.0.0.1", port), handler)
+
+
+def serve(root: str = "store", port: int = 8080) -> None:
+    srv = make_server(root, port)
+    print(f"serving {root} on http://127.0.0.1:{port}")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.server_close()

@@ -1,0 +1,114 @@
+"""DB/OS automation tests against the dummy control plane, mirroring
+the reference's cycle-with-retry semantics (db.clj:24-67)."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import db as dblib
+from jepsen_tpu import os as oslib
+from jepsen_tpu.control import DummyRemote
+from jepsen_tpu.generator import pure as gen
+from jepsen_tpu.runtime import AtomClient, run
+
+NODES = ["n1", "n2", "n3"]
+
+
+class RecordingDB(dblib.DB):
+    def __init__(self, fail_setups=0):
+        self.calls = []
+        self.fail_setups = fail_setups
+        self._lock = threading.Lock()
+
+    def setup(self, test, node, session):
+        with self._lock:
+            self.calls.append(("setup", node))
+            if self.fail_setups > 0:
+                self.fail_setups -= 1
+                raise dblib.SetupFailed(f"flaky setup on {node}")
+        session.exec("install-db", node)
+
+    def teardown(self, test, node, session):
+        with self._lock:
+            self.calls.append(("teardown", node))
+
+    def setup_primary(self, test, node, session):
+        with self._lock:
+            self.calls.append(("primary", node))
+
+
+def test_cycle_runs_teardown_setup_primary():
+    db = RecordingDB()
+    test = {"nodes": NODES, "remote": DummyRemote(), "db": db}
+    dblib.cycle(test)
+    kinds = [k for k, _ in db.calls]
+    assert kinds.count("teardown") == 3
+    assert kinds.count("setup") == 3
+    assert ("primary", "n1") in db.calls
+    assert db.calls.index(("primary", "n1")) > kinds.index("setup")
+
+
+def test_cycle_retries_on_setup_failed():
+    db = RecordingDB(fail_setups=2)  # first two setups explode
+    test = {"nodes": NODES, "remote": DummyRemote(), "db": db}
+    dblib.cycle(test)
+    kinds = [k for k, _ in db.calls]
+    # at least two full cycles: >3 teardowns
+    assert kinds.count("teardown") >= 6
+
+
+def test_cycle_gives_up_after_tries():
+    db = RecordingDB(fail_setups=99)
+    test = {"nodes": NODES, "remote": DummyRemote(), "db": db}
+    with pytest.raises(RuntimeError):
+        dblib.cycle(test)
+
+
+def test_run_engages_db_and_os_lifecycle():
+    db = RecordingDB()
+    os_calls = []
+
+    class RecordingOS(oslib.OS):
+        def setup(self, test, node, session):
+            os_calls.append(node)
+
+    test = run({
+        "nodes": NODES,
+        "remote": DummyRemote(),
+        "os": RecordingOS(),
+        "db": db,
+        "client": AtomClient(),
+        "generator": gen.clients(gen.limit(5, {"f": "read"})),
+        "concurrency": 2,
+    })
+    assert sorted(os_calls) == NODES
+    kinds = [k for k, _ in db.calls]
+    assert kinds.count("setup") == 3
+    # final teardown after the run
+    assert kinds[-1] == "teardown"
+    assert test["results"]["valid?"] is True
+
+
+def test_debian_os_emits_package_install():
+    remote = DummyRemote(responses={"dpkg-query": (0, "curl\ntar\n", "")})
+    test = {"nodes": ["n1"], "node_ips": {"n1": "10.0.0.1"},
+            "remote": remote}
+    from jepsen_tpu.control.core import sessions_for
+
+    deb = oslib.Debian()
+    deb.setup(test, "n1", sessions_for(test)["n1"])
+    cmds = remote.commands("n1")
+    assert any("apt-get install -y" in c and "iptables" in c for c in cmds)
+    assert any("/etc/hosts" in c for c in cmds)
+
+
+def test_snarf_logs_downloads(tmp_path):
+    class LogDB(dblib.DB):
+        def log_files(self, test, node):
+            return [f"/var/log/db-{node}.log"]
+
+    remote = DummyRemote()
+    test = {"nodes": NODES, "remote": remote, "db": LogDB()}
+    dblib.snarf_logs(test, str(tmp_path))
+    downloads = [e for e in remote.log if e["type"] == "download"]
+    assert len(downloads) == 3

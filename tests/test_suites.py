@@ -1,0 +1,101 @@
+"""Suite shape tests: the full etcd/zookeeper test maps run end-to-end
+in dummy mode (in-memory client + MemNet — the atom-db trick at suite
+scale), and the real-mode DB emits the right command shapes against
+the recording dummy control plane."""
+
+import random
+
+from jepsen_tpu.control import DummyRemote
+from jepsen_tpu.control.core import sessions_for
+from jepsen_tpu.generator import pure as gen
+from jepsen_tpu.runtime import run
+from jepsen_tpu.suites import etcd, zookeeper
+
+
+def test_etcd_dummy_suite_end_to_end(tmp_path):
+    test = etcd.etcd_test({
+        "dummy": True,
+        "keys": 3,
+        "per_key_limit": 15,
+        "threads_per_key": 2,
+        "stagger": 0.0005,
+        "nemesis_interval": 0.15,
+        "time_limit": 3.0,
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "rng": random.Random(7),
+    })
+    test["run_dir"] = str(tmp_path)
+    test["concurrency"] = 6
+    test = run(test)
+    results = test["results"]
+    assert results["valid?"] is True
+    assert results["indep"]["key_count"] == 3
+    assert results["timeline"]["file"] is not None
+    # the nemesis cycle actually fired
+    nem_fs = [o.f for o in test["history"].ops
+              if o.process == "nemesis" and o.type == "info"]
+    assert "start" in nem_fs
+
+
+def test_etcd_db_emits_install_and_daemon_commands():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2", "n3"], "remote": remote,
+            "db_start_wait": 0}
+    db = etcd.EtcdDB()
+    sess = sessions_for(test)
+    db.setup(test, "n1", sess["n1"])
+    cmds = remote.commands("n1")
+    assert any("wget" in c and "etcd-v3.1.5" in c for c in cmds)
+    assert any("--initial-cluster" in c
+               and "n1=http://n1:2380" in c for c in cmds)
+    assert any("etcd.pid" in c for c in cmds)
+    db.teardown(test, "n1", sess["n1"])
+    assert any("rm -rf /opt/etcd" in c for c in remote.commands("n1"))
+
+
+def test_etcd_initial_cluster_string():
+    t = {"nodes": ["a", "b"]}
+    assert etcd.initial_cluster(t) == (
+        "a=http://a:2380,b=http://b:2380"
+    )
+
+
+def test_zookeeper_dummy_suite():
+    test = zookeeper.zookeeper_test({
+        "dummy": True,
+        "keys": 2,
+        "per_key_limit": 10,
+        "rng": random.Random(3),
+    })
+    test["nodes"] = ["n1", "n2", "n3"]
+    test["concurrency"] = 4
+    test = run(test)
+    assert test["results"]["valid?"] is True
+
+
+def test_zookeeper_db_config_rendering():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2", "n3"], "remote": remote}
+    db = zookeeper.ZookeeperDB()
+    sess = sessions_for(test)
+    db.setup(test, "n2", sess["n2"])
+    cmds = remote.commands("n2")
+    assert any("apt-get install -y zookeeper" in c for c in cmds)
+    assert any("myid" in c for c in cmds)
+    assert any("zoo.cfg" in c for c in cmds)
+
+
+def test_sleep_and_repeat_generators():
+    # sleep anchors on first poll; repeat cycles the factory.
+    ctx = gen.context(time=0, free_threads=(0,), workers={0: 0})
+    s = gen.sleep(1e-6)  # 1000 nanos
+    o, s2 = gen.op(s, {}, ctx)
+    assert o is gen.PENDING
+    ctx2 = dict(ctx)
+    ctx2["time"] = 2000
+    assert gen.op(s2, {}, ctx2) is None  # expired
+    # repeat: [sleep, op] cycles
+    r = gen.repeat(lambda: [gen.once({"f": "tick"})])
+    o1, r = gen.op(r, {}, ctx)
+    o2, r = gen.op(r, {}, ctx)
+    assert o1["f"] == o2["f"] == "tick"
