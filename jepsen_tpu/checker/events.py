@@ -373,7 +373,14 @@ def history_to_events(
             codes[k] = c
         return c
 
-    init_state = code(init_value)
+    # Kernel-capable models need an int initial state (e.g. mutex
+    # starts unlocked=0 regardless of the interned init code); initial()
+    # is idempotent for every model, so the oracle may apply it again.
+    init_state = (
+        int(m.initial(code(init_value)))
+        if m.jax_capable
+        else code(init_value)
+    )
 
     kind: List[int] = []
     slot: List[int] = []
@@ -402,7 +409,7 @@ def history_to_events(
         # Only cas payloads spread [old, new] across (a, b); any other
         # value — including a 2-element list written to the register —
         # interns whole (same gating as columnar.Encoder.encode_payload).
-        if fc == F_CAS:
+        if fc == F_CAS and m.f_names.get("cas") == F_CAS:
             # A cas payload must be [old, new]; anything else is outside
             # the model (encoding b=0 would alias a legitimate value
             # code and let the kernel "succeed" a garbage cas).
@@ -424,8 +431,8 @@ def history_to_events(
             if fab is None:
                 continue  # outside the model
             fc, a, b = fab
-            if op.get("crashed") and fc == F_READ:
-                continue  # unconstrained crashed read: no effect
+            if op.get("crashed") and fc in m.crashed_droppable_fs:
+                continue  # unconstrained crashed op: no effect
             if free:
                 s = heapq.heappop(free)
             elif next_fresh < max_window:

@@ -107,15 +107,25 @@ def check_events_bucketed(
     Returns {"valid?": bool, "method": "tpu-wgl"|"cpu-oracle",
              "frontier_k": K or None, "escalations": int}.
     """
+    from jepsen_tpu.checker.models import model as get_model
+
     W = _bucket_window(max(events.window, 1))
-    if W is None:
+    m = get_model(model)
+    if W is None or not m.jax_capable:
+        # Too concurrent for the masks, or the model's state doesn't
+        # fit a machine word (queue multisets): the oracle decides.
+        reason = (
+            f"window {events.window} exceeds {W_BUCKETS[-1]} slots"
+            if W is None
+            else f"model {m.name} is host-only (rich state)"
+        )
         valid, stats = oracle_check(events, model=model, return_stats=True)
         out = {
             "valid?": valid,
             "method": "cpu-oracle",
             "frontier_k": None,
             "escalations": 0,
-            "reason": f"window {events.window} exceeds {W_BUCKETS[-1]} slots",
+            "reason": reason,
         }
         if not valid:
             out["failed_op_index"] = stats["failed_op_index"]

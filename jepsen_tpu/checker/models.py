@@ -27,7 +27,7 @@ needs the success transition (matching knossos's cas-register step).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax.numpy as jnp
 
@@ -90,19 +90,40 @@ def register_step_jax(state, f, a, b):
 
 class Model:
     """A named model: python + jax step functions over int32 codes, plus
-    the op.f -> f-code mapping used when encoding histories."""
+    the op.f -> f-code mapping used when encoding histories.
+
+    jax_capable=False marks models whose state does not fit a machine
+    word (e.g. queue multisets): those route to the CPU oracle, whose
+    configurations carry arbitrary hashable state via initial().
+    crashed_droppable_fs lists f-codes whose crashed (:info) invocations
+    are unconstrained no-ops and may be dropped at encode time (register
+    reads; an acquired-but-crashed lock or a crashed enqueue still
+    mutates state, so they must stay open)."""
 
     def __init__(
         self,
         name: str,
         step_py: Callable,
-        step_jax: Callable,
+        step_jax: Optional[Callable],
         f_names: Dict[Any, int],
+        jax_capable: bool = True,
+        initial: Optional[Callable[[int], Any]] = None,
+        crashed_droppable_fs: Tuple[int, ...] = (),
     ):
         self.name = name
         self.step_py = step_py
         self.step_jax = step_jax
         self.f_names = f_names
+        self.jax_capable = jax_capable
+        self._initial = initial
+        self.crashed_droppable_fs = frozenset(crashed_droppable_fs)
+
+    def initial(self, init_code: int):
+        """The model's initial configuration state for an interned
+        initial-value code (identity for register-family models)."""
+        if self._initial is not None:
+            return self._initial(init_code)
+        return init_code
 
     def f_code(self, f) -> int:
         """Model f-code for an op.f, or -1 if the op is outside the model."""
@@ -112,12 +133,82 @@ class Model:
         return f"Model({self.name})"
 
 
+# -- mutex (knossos model/mutex; used by checker_test.clj:5-7) ---------------
+
+F_ACQUIRE, F_RELEASE = 0, 1
+
+MUTEX_F_NAMES: Dict[Any, int] = {
+    "acquire": F_ACQUIRE,
+    ":acquire": F_ACQUIRE,
+    "lock": F_ACQUIRE,
+    "release": F_RELEASE,
+    ":release": F_RELEASE,
+    "unlock": F_RELEASE,
+}
+
+
+def mutex_step_py(state: int, f: int, a: int, b: int) -> Tuple[bool, int]:
+    if f == F_ACQUIRE:
+        return state == 0, 1
+    if f == F_RELEASE:
+        return state == 1, 0
+    raise ValueError(f"unknown f code {f}")
+
+
+def mutex_step_jax(state, f, a, b):
+    is_acq = f == F_ACQUIRE
+    ok = (is_acq & (state == 0)) | (~is_acq & (state == 1))
+    # state*0 keeps the frontier axis in the output shape (the kernels
+    # broadcast [K,1] state against [1,W] ops).
+    state2 = state * 0 + jnp.where(is_acq, 1, 0)
+    return ok, state2
+
+
+# -- unordered queue (knossos model/unordered-queue) -------------------------
+
+F_ENQ, F_DEQ = 0, 1
+
+QUEUE_F_NAMES: Dict[Any, int] = {
+    "enqueue": F_ENQ,
+    ":enqueue": F_ENQ,
+    "enq": F_ENQ,
+    "dequeue": F_DEQ,
+    ":dequeue": F_DEQ,
+    "deq": F_DEQ,
+}
+
+
+def unordered_queue_step_py(state, f: int, a: int, b: int):
+    """State is a multiset of value codes as a sorted tuple (hashable
+    for the oracle's config sets). Enqueue always succeeds; dequeue
+    succeeds iff the value is present."""
+    if f == F_ENQ:
+        return True, tuple(sorted(state + (a,)))
+    if f == F_DEQ:
+        if a in state:
+            out = list(state)
+            out.remove(a)
+            return True, tuple(out)
+        return False, state
+    raise ValueError(f"unknown f code {f}")
+
+
 MODELS: Dict[str, Model] = {
     "cas-register": Model(
-        "cas-register", cas_register_step_py, cas_register_step_jax, F_NAMES
+        "cas-register", cas_register_step_py, cas_register_step_jax,
+        F_NAMES, crashed_droppable_fs=(F_READ,),
     ),
     "register": Model(
-        "register", register_step_py, register_step_jax, F_NAMES
+        "register", register_step_py, register_step_jax, F_NAMES,
+        crashed_droppable_fs=(F_READ,),
+    ),
+    "mutex": Model(
+        "mutex", mutex_step_py, mutex_step_jax, MUTEX_F_NAMES,
+        initial=lambda init_code: 0,
+    ),
+    "unordered-queue": Model(
+        "unordered-queue", unordered_queue_step_py, None, QUEUE_F_NAMES,
+        jax_capable=False, initial=lambda init_code: (),
     ),
 }
 

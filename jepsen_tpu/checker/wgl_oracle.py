@@ -107,7 +107,7 @@ def check_events(
     """
     m: Model = get_model(model)
     step = m.step_py
-    frontier: Set[Tuple[int, int]] = {(events.init_state, 0)}
+    frontier: Set[Tuple[Any, int]] = {(m.initial(events.init_state), 0)}
     open_ops: dict = {}
     max_frontier = 1
     crashed_mask = 0
@@ -207,7 +207,7 @@ def check_brute(
         return True
 
     def run_ok(order: Iterable[int]) -> bool:
-        state = events.init_state
+        state = m.initial(events.init_state)
         for op_id in order:
             f, a, b = ops[op_id][:3]
             ok, state = step(state, f, a, b)

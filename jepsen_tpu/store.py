@@ -25,6 +25,14 @@ from jepsen_tpu.history.ops import Op
 
 DEFAULT_ROOT = "store"
 
+#: single-key shapes reserved by the tag scheme: a genuine user dict
+#: with exactly one of these keys encodes via __dict__ instead, so
+#: decode never misreads it
+_TAGS = (
+    frozenset({"__kv__"}), frozenset({"__tuple__"}),
+    frozenset({"__set__"}), frozenset({"__dict__"}),
+)
+
 #: test-map slots that are protocol objects / runtime state — never
 #: serialized (store.clj:167-175's nonserializable-keys)
 STRIP_KEYS = (
@@ -50,7 +58,7 @@ def _encode_value(v):
             )
         }
     if isinstance(v, dict):
-        if all(isinstance(k, str) for k in v):
+        if all(isinstance(k, str) for k in v) and set(v) not in _TAGS:
             return {k: _encode_value(x) for k, x in v.items()}
         # Non-string keys (account ids, key numbers): JSON would
         # stringify them, so keep them as tagged pairs.

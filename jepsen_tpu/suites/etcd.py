@@ -240,6 +240,7 @@ def main(argv=None) -> int:
     p.add_argument("--concurrency", default=None)
     p.add_argument("--time-limit", type=float, default=30.0)
     p.add_argument("--keys", type=int, default=50)
+    p.add_argument("--threads-per-key", type=int, default=10)
     p.add_argument("--dummy", action="store_true")
     p.add_argument("--store", default="store")
     args = p.parse_args(argv)
@@ -247,11 +248,15 @@ def main(argv=None) -> int:
     test = etcd_test({
         "dummy": args.dummy,
         "keys": args.keys,
+        "threads_per_key": args.threads_per_key,
         "nodes": nodes,
     })
-    test["concurrency"] = (
+    concurrency = (
         int(args.concurrency) if args.concurrency else 2 * len(nodes)
     )
+    # the keyed generator needs whole thread groups
+    concurrency += (-concurrency) % args.threads_per_key
+    test["concurrency"] = concurrency
     test["generator"] = gen.time_limit(
         args.time_limit, test["generator"]
     )

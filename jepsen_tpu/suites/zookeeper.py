@@ -17,9 +17,95 @@ from jepsen_tpu import independent, nemesis as nemlib, net as netlib
 from jepsen_tpu.checker import core as checker_core
 from jepsen_tpu.checker.linearizable import LinearizableChecker
 from jepsen_tpu.checker.timeline import html_timeline
+from jepsen_tpu.control.core import RemoteError, sessions_for
 from jepsen_tpu.db import DB
 from jepsen_tpu.generator import pure as gen
 from jepsen_tpu.os import Debian
+from jepsen_tpu.runtime.client import Client, ClientFailed
+
+ZKCLI = "/usr/share/zookeeper/bin/zkCli.sh"
+
+
+class ZkCliClient(Client):
+    """Keyed register client over zkCli on the node itself: znodes
+    /jepsen/r<k>, reads via `get -s` (data + dataVersion), writes via
+    `create`/`set`, cas via version-checked `set` (BadVersion -> fail).
+    Transport errors crash reads to :fail and mutations to :info, like
+    the reference's client error taxonomy."""
+
+    def __init__(self, node=None):
+        self.node = node
+
+    def open(self, test, node):
+        return ZkCliClient(node)
+
+    def _zk(self, test, *args):
+        sess = sessions_for(test)[self.node]
+        return sess.exec(
+            ZKCLI, "-server", f"{self.node}:2181", *args
+        )
+
+    def _get(self, test, path):
+        """-> (value or None, version or None)"""
+        try:
+            out = self._zk(test, "get", "-s", path)
+        except RemoteError as e:
+            if "does not exist" in (e.out + e.err + str(e)):
+                return None, None
+            raise
+        if "Node does not exist" in out:
+            return None, None
+        lines = [ln for ln in out.splitlines() if ln.strip()]
+        data = None
+        version = None
+        for i, ln in enumerate(lines):
+            if ln.startswith("cZxid"):
+                data = lines[i - 1] if i > 0 else None
+            if ln.startswith("dataVersion"):
+                version = int(ln.split("=")[-1].strip())
+        try:
+            data = int(data) if data is not None else None
+        except ValueError:
+            data = None
+        return data, version
+
+    def invoke(self, test, op):
+        kv = op.value
+        if not isinstance(kv, independent.KV):
+            raise ValueError(f"expected KV value, got {kv!r}")
+        k, v = kv.key, kv.value
+        path = f"/jepsen-r{k}"
+        try:
+            if op.f == "read":
+                data, _ = self._get(test, path)
+                return op.with_(
+                    type="ok", value=independent.KV(k, data)
+                )
+            if op.f == "write":
+                try:
+                    self._zk(test, "create", path, str(v))
+                except RemoteError as e:
+                    if "already exists" not in (e.out + e.err + str(e)):
+                        raise
+                    self._zk(test, "set", path, str(v))
+                return op.with_(type="ok")
+            if op.f == "cas":
+                old, new = v
+                data, version = self._get(test, path)
+                if data != old or version is None:
+                    return op.with_(type="fail")
+                try:
+                    self._zk(test, "set", path, str(new), str(version))
+                    return op.with_(type="ok")
+                except RemoteError as e:
+                    if "BadVersion" in (e.out + e.err + str(e)):
+                        return op.with_(type="fail")
+                    raise
+            raise ValueError(f"unknown op f={op.f!r}")
+        except RemoteError as e:
+            if op.f == "read":
+                raise ClientFailed(str(e))
+            raise  # runtime records :info (indeterminate)
 
 
 class ZookeeperDB(DB):
@@ -82,6 +168,7 @@ def zookeeper_test(opts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         "name": "zookeeper",
         "os": Debian(),
         "db": ZookeeperDB(),
+        "client": ZkCliClient(),
         "net": netlib.IptablesNet(),
         "nemesis": nemlib.partition_random_halves(rng=rng),
         "generator": gen.clients(client_gen),

@@ -344,3 +344,81 @@ def test_failed_op_index_matches_oracle():
             assert got["failed_op_index"] == stats["failed_op_index"], (
                 f"seed {seed}"
             )
+
+
+# -- mutex + unordered-queue models (knossos parity,
+# jepsen/test/jepsen/checker_test.clj:5-7 constructors) ----------------------
+
+
+def test_mutex_model():
+    ok = H(
+        invoke_op(0, "acquire"),
+        ok_op(0, "acquire"),
+        invoke_op(0, "release"),
+        ok_op(0, "release"),
+        invoke_op(1, "acquire"),
+        ok_op(1, "acquire"),
+    )
+    ev = history_to_events(ok, model="mutex")
+    assert check_events_bucketed(ev, model="mutex")["valid?"] is True
+    # double acquire with no interleaving release: invalid
+    bad = H(
+        invoke_op(0, "acquire"),
+        ok_op(0, "acquire"),
+        invoke_op(1, "acquire"),
+        ok_op(1, "acquire"),
+    )
+    ev = history_to_events(bad, model="mutex")
+    r = check_events_bucketed(ev, model="mutex")
+    assert r["valid?"] is False
+    # concurrent acquires: only one may win -> still valid if the other
+    # is unresolved (:info)
+    conc = H(
+        invoke_op(0, "acquire"),
+        invoke_op(1, "acquire"),
+        ok_op(0, "acquire"),
+        info_op(1, "acquire"),
+    )
+    ev = history_to_events(conc, model="mutex")
+    assert check_events_bucketed(ev, model="mutex")["valid?"] is True
+
+
+def test_unordered_queue_model():
+    # enqueue/dequeue in any order is fine as long as dequeues are
+    # backed by enqueues (checker.clj:160-180's knossos queue check).
+    ok = H(
+        invoke_op(0, "enqueue", 1),
+        ok_op(0, "enqueue", 1),
+        invoke_op(1, "enqueue", 2),
+        ok_op(1, "enqueue", 2),
+        invoke_op(0, "dequeue"),
+        ok_op(0, "dequeue", 2),
+        invoke_op(1, "dequeue"),
+        ok_op(1, "dequeue", 1),
+    )
+    ev = history_to_events(ok, model="unordered-queue")
+    r = check_events_bucketed(ev, model="unordered-queue")
+    assert r["valid?"] is True
+    assert r["method"] == "cpu-oracle"  # rich state: host-only
+    # dequeue of a value never enqueued: invalid
+    bad = H(
+        invoke_op(0, "enqueue", 1),
+        ok_op(0, "enqueue", 1),
+        invoke_op(0, "dequeue"),
+        ok_op(0, "dequeue", 9),
+    )
+    ev = history_to_events(bad, model="unordered-queue")
+    assert check_events_bucketed(ev, model="unordered-queue")[
+        "valid?"
+    ] is False
+    # dequeue racing its enqueue: legal
+    race = H(
+        invoke_op(0, "enqueue", 5),
+        invoke_op(1, "dequeue"),
+        ok_op(0, "enqueue", 5),
+        ok_op(1, "dequeue", 5),
+    )
+    ev = history_to_events(race, model="unordered-queue")
+    assert check_events_bucketed(ev, model="unordered-queue")[
+        "valid?"
+    ] is True

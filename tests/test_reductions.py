@@ -468,3 +468,55 @@ def test_unique_ids_unhashable_duplicates():
     r = UniqueIdsChecker().check(None, h, {})
     assert r["valid?"] is False
     assert r["duplicated-count"] == 1
+
+
+def test_counter_device_path_parity():
+    # The jit device path and the numpy path must agree bit-for-bit.
+    import random as _random
+
+    from jepsen_tpu.history.ops import invoke_op, ok_op
+
+    rng = _random.Random(4)
+    ops = []
+    val = 0
+    for i in range(300):
+        p = rng.randrange(4)
+        if rng.random() < 0.5:
+            d = rng.randrange(1, 5)
+            ops.append(invoke_op(p, "add", d))
+            ops.append(ok_op(p, "add", d))
+            val += d
+        else:
+            ops.append(invoke_op(p, "read"))
+            ops.append(ok_op(p, "read", val))
+    h = History(ops)
+    a = CounterChecker().check({}, h, force_device=False)
+    b = CounterChecker().check({}, h, force_device=True)
+    assert a == b
+    assert a["valid?"] is True
+
+
+def test_set_full_blocked_matches_unblocked(monkeypatch):
+    import random as _random
+
+    import jepsen_tpu.checker.reductions as red
+    from jepsen_tpu.history.ops import invoke_op, ok_op
+
+    rng = _random.Random(9)
+    ops = []
+    seen = []
+    for i in range(40):
+        p = rng.randrange(3)
+        if rng.random() < 0.5 or not seen:
+            ops.append(invoke_op(p, "add", i))
+            ops.append(ok_op(p, "add", i))
+            seen.append(i)
+        else:
+            obs = [x for x in seen if rng.random() < 0.8]
+            ops.append(invoke_op(p, "read"))
+            ops.append(ok_op(p, "read", obs))
+    h = History(ops)
+    full = SetFullChecker().check({}, h)
+    monkeypatch.setattr(red, "_SETFULL_BLOCK_CELLS", 64)  # force blocks
+    blocked = SetFullChecker().check({}, h)
+    assert full == blocked

@@ -5,6 +5,7 @@ analyze seam (re-check a stored history with no cluster), exit codes,
 import json
 import os
 import random
+import urllib.error
 import urllib.request
 import threading
 
@@ -150,10 +151,16 @@ def test_web_dashboard_renders(tmp_path):
             f"http://127.0.0.1:{port}/files/webdemo/{stamp}/history.jsonl"
         ).read().decode()
         assert '"read"' in hist
-        # traversal guarded
-        code = urllib.request.urlopen(
-            f"http://127.0.0.1:{port}/files/../../etc/passwd"
-        ).getcode() if False else None
+        # traversal guarded: anything resolving outside the store root
+        # must be rejected (403/404), never served.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}//files/%2e%2e/%2e%2e/etc/passwd"
+        )
+        try:
+            resp = urllib.request.urlopen(req)
+            assert resp.getcode() in (403, 404)
+        except urllib.error.HTTPError as e:
+            assert e.code in (403, 404)
     finally:
         srv.shutdown()
         srv.server_close()

@@ -99,3 +99,30 @@ def test_sleep_and_repeat_generators():
     o1, r = gen.op(r, {}, ctx)
     o2, r = gen.op(r, {}, ctx)
     assert o1["f"] == o2["f"] == "tick"
+
+
+def test_zkcli_client_command_shapes():
+    out_get = (
+        "5\ncZxid = 0x2\nmZxid = 0x5\ndataVersion = 3\n"
+    )
+    remote = DummyRemote(responses={"get -s": (0, out_get, "")})
+    test = {"nodes": ["n1"], "remote": remote}
+    from jepsen_tpu import independent
+
+    c = zookeeper.ZkCliClient().open(test, "n1")
+    # read parses data + uses zkCli get -s
+    op = run.__globals__  # noqa: F841 (namespace touch)
+    from jepsen_tpu.history.ops import invoke_op
+
+    o = c.invoke(test, invoke_op(0, "read", independent.KV(7, None)))
+    assert o.type == "ok" and o.value.value == 5
+    # cas with matching value issues versioned set
+    o = c.invoke(test, invoke_op(0, "cas", independent.KV(7, (5, 9))))
+    assert o.type == "ok"
+    cmds = remote.commands("n1")
+    assert any("zkCli.sh -server n1:2181 get -s /jepsen-r7" in c_
+               for c_ in cmds)
+    assert any("set /jepsen-r7 9 3" in c_ for c_ in cmds)
+    # cas with stale expectation fails cleanly
+    o = c.invoke(test, invoke_op(0, "cas", independent.KV(7, (4, 9))))
+    assert o.type == "fail"

@@ -1,0 +1,100 @@
+"""Clock-fault toolkit tests: the C++ tools compile and compute
+correctly (via LocalRemote, --print-only so the host clock is never
+touched), and the clock nemesis emits the right command shapes."""
+
+import random
+import subprocess
+import time
+
+import pytest
+
+from jepsen_tpu import faketime, nemesis_time
+from jepsen_tpu.control import DummyRemote, LocalRemote, Session
+from jepsen_tpu.control.core import sessions_for
+from jepsen_tpu.history.ops import invoke_op
+
+
+def test_cpp_tools_compile_and_compute(tmp_path):
+    s = Session(LocalRemote(), "local")
+    import os
+
+    res = os.path.join(
+        os.path.dirname(nemesis_time.__file__), "resources"
+    )
+    for name in ("bump_time", "strobe_time"):
+        s.exec(
+            "g++", "-O2", "-o", str(tmp_path / name),
+            os.path.join(res, f"{name}.cc"),
+        )
+    # bump --print-only: target ~ now + delta
+    out = s.exec(str(tmp_path / "bump_time"), "--print-only", "60000")
+    target = float(out.strip())
+    assert abs(target - (time.time() + 60)) < 5
+    # negative delta
+    out = s.exec(str(tmp_path / "bump_time"), "--print-only", "-60000")
+    assert abs(float(out.strip()) - (time.time() - 60)) < 5
+    # strobe --print-only: flip count = duration/period
+    out = s.exec(
+        str(tmp_path / "strobe_time"), "--print-only", "100", "50", "4"
+    )
+    assert int(out.strip()) == 80
+
+
+def test_clock_nemesis_command_shapes():
+    remote = DummyRemote(responses={"date +%s.%N": (0, "0.0\n", "")})
+    test = {"nodes": ["n1", "n2"], "remote": remote}
+    nem = nemesis_time.clock_nemesis().setup(test)
+    cmds = remote.commands("n1")
+    assert any("g++ -O2 -o /opt/jepsen-tpu/bump_time" in c for c in cmds)
+    uploads = [e for e in remote.log if e["type"] == "upload"]
+    assert any("bump_time.cc" in e["remote"] for e in uploads)
+
+    out = nem.invoke(test, invoke_op("nemesis", "bump", {"n1": 30000}))
+    assert out.type == "info"
+    assert any(
+        "/opt/jepsen-tpu/bump_time 30000" in c
+        for c in remote.commands("n1")
+    )
+
+    out = nem.invoke(test, invoke_op(
+        "nemesis", "strobe",
+        {"n2": {"delta": 100, "period": 10, "duration": 5}},
+    ))
+    assert any(
+        "/opt/jepsen-tpu/strobe_time 100 10 5" in c
+        for c in remote.commands("n2")
+    )
+
+    out = nem.invoke(test, invoke_op("nemesis", "check-offsets"))
+    assert set(out.value["clock-offsets"]) == {"n1", "n2"}
+
+    out = nem.invoke(test, invoke_op("nemesis", "reset"))
+    assert any("date +%s -s @" in c for c in remote.commands("n2"))
+
+
+def test_clock_gen_produces_valid_ops():
+    rng = random.Random(2)
+    g = nemesis_time.clock_gen(rng)
+    test = {"nodes": ["n1", "n2", "n3"]}
+    fs = set()
+    for _ in range(40):
+        o = g(test, {})
+        fs.add(o["f"])
+        if o["f"] == "bump":
+            assert all(abs(v) >= 1000 for v in o["value"].values())
+    assert {"reset", "bump", "strobe", "check-offsets"} <= fs
+
+
+def test_faketime_wrapper_script():
+    remote = DummyRemote()
+    test = {"nodes": ["n1"], "remote": remote}
+    s = sessions_for(test)["n1"]
+    faketime.wrap_binary(s, "/opt/db/bin/db", rate=5.0, offset_s=-2.0)
+    cmds = remote.commands("n1")
+    assert any("mv /opt/db/bin/db /opt/db/bin/db.real" in c for c in cmds)
+    assert any("chmod +x /opt/db/bin/db" in c for c in cmds)
+    faketime.unwrap_binary(s, "/opt/db/bin/db")
+    assert any(
+        "mv -f /opt/db/bin/db.real /opt/db/bin/db" in c
+        for c in remote.commands("n1")
+    )
