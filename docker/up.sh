@@ -1,0 +1,11 @@
+#!/bin/sh
+# Bring up the 5+1 harness and drop into the control container
+# (reference: docker/README.md:10-17's ./up.sh).
+set -e
+cd "$(dirname "$0")"
+docker compose up -d --build
+echo "cluster up: n1..n5 + control"
+echo "run tests from the control node, e.g.:"
+echo "  docker exec -it jepsen-control \\"
+echo "    python -m jepsen_tpu.suites.etcd --nodes n1,n2,n3,n4,n5"
+docker exec -it jepsen-control bash

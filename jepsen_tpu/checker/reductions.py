@@ -206,7 +206,7 @@ class CounterChecker:
         where = np.searchsorted(sorted_idx, pair_idx)
         where = np.clip(where, 0, len(order) - 1)
         comp_pos = order[where]
-        found = (sorted_idx[np.clip(where, 0, len(order) - 1)] == pair_idx)
+        found = sorted_idx[where] == pair_idx
         keep = (pair_idx >= 0) & found & is_ok[comp_pos]
         inv_positions = inv_positions[keep]
         comp_pos = comp_pos[keep]

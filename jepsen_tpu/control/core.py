@@ -27,6 +27,11 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 
+import logging
+
+_trace_log = logging.getLogger("jepsen_tpu.control")
+
+
 class RemoteError(RuntimeError):
     """Nonzero exit from a remote command (control.clj:122-135)."""
 
@@ -267,6 +272,10 @@ class Session:
 
     def exec(self, *cmd, sudo: bool = False, cd: Optional[str] = None,
              stdin: Optional[str] = None, check: bool = True) -> str:
+        # Command audit trace (control.clj:19,117-121's *trace*): every
+        # remote command logs through jepsen_tpu.control, which the run
+        # directory's jepsen.log captures.
+        _trace_log.debug("%s$ %s", self.node, _wrap(cmd, sudo, cd))
         last: Optional[BaseException] = None
         for attempt in range(self.retries):
             try:

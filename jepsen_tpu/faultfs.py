@@ -1,0 +1,135 @@
+"""Disk-fault injection driver: deploy and control the faultfs shim.
+
+Reference: charybdefs/src/jepsen/charybdefs.clj — build the fault
+filesystem on the node (:7-65) and flip faults at runtime: every op
+EIO (:67-72), a percentage of ops (:74-79), clear (:81-85). Here the
+native component is resources/faultfs.cc, an LD_PRELOAD interposer (see
+its header for why that beats a FUSE mount in the container era, and
+its scope note: libc-dynamic databases only — statically-linked Go
+binaries need kernel-level fault injection); the DB under test starts
+with `env_for(...)` in its daemon environment, and the nemesis mutates
+the per-node config file over the control plane.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+from typing import Dict, Optional
+
+from jepsen_tpu.control.core import Session, on_nodes
+from jepsen_tpu.history.ops import Op
+from jepsen_tpu.nemesis import Nemesis
+
+TOOL_DIR = "/opt/jepsen-tpu"
+SO_PATH = f"{TOOL_DIR}/faultfs.so"
+CONF_PATH = f"{TOOL_DIR}/faultfs.conf"
+_RES = os.path.join(os.path.dirname(__file__), "resources")
+
+
+def install(session: Session) -> None:
+    """Upload + compile the shim on a node (the build-on-node discipline
+    of charybdefs.clj:40-55, minus the Thrift toolchain)."""
+    session.exec("mkdir", "-p", TOOL_DIR, sudo=True)
+    session.exec("chmod", "777", TOOL_DIR, sudo=True)
+    src = f"{TOOL_DIR}/faultfs.cc"
+    session.upload(os.path.join(_RES, "faultfs.cc"), src)
+    session.exec(
+        "g++", "-O2", "-shared", "-fPIC", "-o", SO_PATH, src, "-ldl",
+    )
+
+
+def env_for(prefix: str) -> Dict[str, str]:
+    """Daemon environment enabling the shim for paths under prefix —
+    pass to control.util.start_daemon(env=...)."""
+    return {
+        "LD_PRELOAD": SO_PATH,
+        "JEPSEN_FAULTFS_CONF": CONF_PATH,
+    }
+
+
+def write_config(
+    session: Session,
+    prefix: str,
+    mode: str = "none",
+    err: int = errno.EIO,
+    probability: int = 0,
+    delay_us: int = 0,
+) -> None:
+    conf = (
+        f"prefix={prefix}\nmode={mode}\nerrno={err}\n"
+        f"probability={probability}\ndelay_us={delay_us}\n"
+    )
+    session.exec("sh", "-c", f"cat > {CONF_PATH}", stdin=conf)
+
+
+class FaultFSNemesis(Nemesis):
+    """f-routed disk faults (charybdefs.clj:67-85):
+
+    - start: every file op under the prefix fails EIO
+    - flaky: value = percent of ops failing (default 1, like the
+      reference's 1%-failure mode)
+    - delay: value = microseconds added per op
+    - clear: faults off
+
+    Op values may instead be {node: spec} dicts to target subsets.
+    """
+
+    def __init__(self, prefix: str):
+        self.prefix = prefix
+
+    def setup(self, test) -> "FaultFSNemesis":
+        def fn(node, sess):
+            install(sess)
+            write_config(sess, self.prefix, mode="none")
+
+        on_nodes(test, fn)
+        return self
+
+    def invoke(self, test, op: Op) -> Op:
+        # Op value: a scalar applied to all nodes, or {node: scalar}
+        # applying each node its OWN spec.
+        value = op.value
+        if isinstance(value, dict) and value and all(
+            n in test["nodes"] for n in value
+        ):
+            per_node = dict(value)
+        else:
+            per_node = {n: value for n in test["nodes"]}
+
+        def kw_for(v) -> dict:
+            if op.f == "start":
+                return {"mode": "fail"}
+            if op.f == "flaky":
+                return {"mode": "flaky",
+                        "probability": int(v) if v is not None else 1}
+            if op.f == "delay":
+                return {"mode": "delay",
+                        "delay_us": int(v) if v is not None else 100_000}
+            if op.f in ("clear", "stop"):
+                return {"mode": "none"}
+            raise ValueError(f"faultfs nemesis can't handle f={op.f!r}")
+
+        def fn(node, sess):
+            kw = kw_for(per_node[node])
+            write_config(sess, self.prefix, **kw)
+            return kw["mode"]
+
+        return op.with_(
+            type="info", value=on_nodes(test, fn, list(per_node))
+        )
+
+    def teardown(self, test) -> None:
+        try:
+            on_nodes(
+                test,
+                lambda node, sess: write_config(
+                    sess, self.prefix, mode="none"
+                ),
+            )
+        except Exception:
+            pass
+
+
+def faultfs_nemesis(prefix: str) -> FaultFSNemesis:
+    return FaultFSNemesis(prefix)

@@ -278,10 +278,12 @@ class ClientWorker(threading.Thread):
                         sched.on_complete(_as_dict(comp))
                         continue
                 inv = rec.append(op)
+                _log_op(test, inv)
                 sched.on_invoke(_as_dict(inv))
                 completion = _invoke_client(self.client, test, inv)
                 completion = completion.with_(time=sched.now())
                 rec.append(completion)
+                _log_op(test, completion)
                 sched.on_complete(_as_dict(completion))
                 if completion.type == "info":
                     # Crash: retire process, cycle the client
@@ -343,6 +345,33 @@ class NemesisWorker(threading.Thread):
             sched.poison(e)
 
 
+def synchronize(test, timeout_s: float = 60.0) -> None:
+    """Block until every node's setup thread arrives (core.clj:40-53).
+    No-op for single-node tests."""
+    barrier = test.get("barrier")
+    if barrier is not None:
+        barrier.wait(timeout=timeout_s)
+
+
+_op_log = None
+
+
+def _log_op(test, op: Op) -> None:
+    """Structured per-op logging (util.clj:208-212, core.clj:311,337):
+    enabled by test["log_ops"]; lines go to the jepsen_tpu.runtime
+    logger (and thus the run-dir jepsen.log when a store is set)."""
+    if not test.get("log_ops"):
+        return
+    import logging
+
+    global _op_log
+    if _op_log is None:
+        _op_log = logging.getLogger("jepsen_tpu.runtime.ops")
+    _op_log.info(
+        "%-8s %-6s %-10s %r", op.process, op.type, op.f, op.value
+    )
+
+
 def _as_dict(op: Op) -> dict:
     return {
         "type": op.type,
@@ -351,6 +380,35 @@ def _as_dict(op: Op) -> dict:
         "process": op.process,
         "time": op.time,
     }
+
+
+def _attach_run_log(run_dir) -> None:
+    """Mirror jepsen_tpu.* logging into <run_dir>/jepsen.log
+    (store.clj:394-422's unilog appender)."""
+    if not run_dir:
+        return
+    import logging
+    import os
+
+    logger = logging.getLogger("jepsen_tpu")
+    path = os.path.join(run_dir, "jepsen.log")
+    for h in logger.handlers:
+        if getattr(h, "_jepsen_run_log", None) == path:
+            return
+    for h in list(logger.handlers):
+        if getattr(h, "_jepsen_run_log", None):
+            logger.removeHandler(h)
+            h.close()
+    h = logging.FileHandler(path)
+    h._jepsen_run_log = path
+    h.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)-5s [%(name)s] %(message)s"
+    ))
+    logger.addHandler(h)
+    if logger.level == logging.NOTSET:
+        # Default to INFO but respect an operator-set level (DEBUG
+        # enables the control-command audit trace).
+        logger.setLevel(logging.INFO)
 
 
 def run(test: Dict[str, Any]) -> Dict[str, Any]:
@@ -378,6 +436,27 @@ def run(test: Dict[str, Any]) -> Dict[str, Any]:
     test.setdefault("start_time", _time.time())
     n = test["concurrency"]
     nodes = test["nodes"]
+    # Cross-node rendezvous for multi-phase DB bring-up, sized to the
+    # node count with the reference's 60s default (core.clj:40-53);
+    # DB.setup implementations call synchronize(test).
+    test.setdefault(
+        "barrier",
+        threading.Barrier(len(nodes)) if len(nodes) > 1 else None,
+    )
+
+    # Run-dir + logging start BEFORE anything executes (store.clj's
+    # start-logging! happens first thing in run!, core.clj:513), so op
+    # and control-command lines land in <run_dir>/jepsen.log.
+    store = None
+    if test.get("store") is not None:
+        from jepsen_tpu.store import Store
+
+        store = (
+            test["store"] if isinstance(test["store"], Store)
+            else Store(str(test["store"]))
+        )
+        store.make_run_dir(test)
+        _attach_run_log(test.get("run_dir"))
 
     threads = list(range(n)) + [NEMESIS]
     t0 = _time.monotonic_ns()
@@ -449,19 +528,11 @@ def run(test: Dict[str, Any]) -> Dict[str, Any]:
     history = History(rec.snapshot())
     test["history"] = history
 
-    # Two-phase persistence around analysis (store.clj:367-392): when
-    # the spec carries a store root, the run directory + history are
-    # saved BEFORE checking (so artifact-writing checkers like the
-    # timeline have a home, and a checker crash still leaves the
+    # Two-phase persistence around analysis (store.clj:367-392): the
+    # history saves BEFORE checking (so artifact-writing checkers like
+    # the timeline have a home, and a checker crash still leaves the
     # history on disk), results after.
-    store = None
-    if test.get("store") is not None:
-        from jepsen_tpu.store import Store
-
-        store = (
-            test["store"] if isinstance(test["store"], Store)
-            else Store(str(test["store"]))
-        )
+    if store is not None:
         store.save_1(test)
 
     checker = test.get("checker")

@@ -37,7 +37,8 @@ _TAGS = (
 #: serialized (store.clj:167-175's nonserializable-keys)
 STRIP_KEYS = (
     "client", "nemesis", "checker", "generator", "db", "os", "net",
-    "remote", "history", "results", "_sessions", "_ip_cache",
+    "remote", "history", "results", "barrier", "store",
+    "_sessions", "_ip_cache",
 )
 
 

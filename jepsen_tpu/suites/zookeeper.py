@@ -41,20 +41,27 @@ class ZkCliClient(Client):
 
     def _zk(self, test, *args):
         sess = sessions_for(test)[self.node]
-        return sess.exec(
+        out = sess.exec(
             ZKCLI, "-server", f"{self.node}:2181", *args
         )
+        # Many zkCli builds exit 0 on command errors and only print the
+        # failure; surface those as RemoteError so callers' error
+        # taxonomy applies uniformly.
+        for marker in ("Node already exists", "Node does not exist",
+                       "version No is not valid", "BadVersion",
+                       "KeeperErrorCode"):
+            if marker in out:
+                raise RemoteError(args, 0, out, marker)
+        return out
 
     def _get(self, test, path):
         """-> (value or None, version or None)"""
         try:
             out = self._zk(test, "get", "-s", path)
         except RemoteError as e:
-            if "does not exist" in (e.out + e.err + str(e)):
+            if "does not exist" in (e.out + str(e.err) + str(e)):
                 return None, None
             raise
-        if "Node does not exist" in out:
-            return None, None
         lines = [ln for ln in out.splitlines() if ln.strip()]
         data = None
         version = None

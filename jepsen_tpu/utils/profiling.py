@@ -1,0 +1,52 @@
+"""Profiling hooks for the TPU analysis plane.
+
+The reference's observability planes are the op log, the control audit
+log, and post-hoc graphs (SURVEY.md §5); the accelerator-resident
+checker adds a fourth: XLA/TPU execution traces. `trace(dir)` wraps any
+checking code in a jax profiler capture viewable in TensorBoard /
+Perfetto; `checker_profile` times a checker run and captures a trace
+into the run directory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device trace for the enclosed block (falls back to a
+    no-op when the profiler can't start, e.g. on CPU test meshes)."""
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception:
+        pass
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+def checker_profile(checker, test, history, opts=None) -> dict:
+    """Run a checker under a profiler trace written into the run dir
+    (subdir xla-trace/); adds wall_s and trace_dir to the verdict."""
+    run_dir = test.get("run_dir") or "."
+    log_dir = os.path.join(run_dir, "xla-trace")
+    t0 = time.perf_counter()
+    with trace(log_dir):
+        out = checker.check(test, history, opts)
+    out = dict(out)
+    out["wall_s"] = time.perf_counter() - t0
+    out["trace_dir"] = log_dir if os.path.isdir(log_dir) else None
+    return out
