@@ -4,28 +4,40 @@ Capability-equivalent rebuild of Jepsen (reference: /root/reference, Clojure).
 The control plane (SSH cluster automation, fault injection, concurrent op
 scheduling) is host-side Python + native C++ tools; the analysis plane (history
 checking: linearizability, transactional and structural invariants) is a
-batched tensor search running under JAX/XLA on TPU.
+batched tensor search running under JAX/XLA on TPU — a Pallas megakernel for
+the WGL frontier scan, vmap/grid batching over independent keys, shard_map
+over device meshes for multi-chip analysis.
 
-Architecture map (reference file:line citations are to /root/reference):
+Module map (reference citations are to /root/reference):
 
-- history/   op + history model, columnar int32 tensor view
-             (ref: knossos op shape; jepsen.txn micro-ops, txn/README.md:7-70)
-- models/    consistency-model state machines + dense transition-table
-             compilation (ref: knossos models, jepsen/src/jepsen/checker.clj:17-23)
-- ops/       pure JAX kernels: frontier expansion, sort-dedup, segment
-             reductions (the TPU-resident hot loops)
-- checkers/  Checker protocol + checker suite
-             (ref: jepsen/src/jepsen/checker.clj)
-- generators/ pure generator protocol + combinators
-             (ref: jepsen/src/jepsen/generator/pure.clj)
-- runtime/   test orchestration: run(), workers, crash cycling
-             (ref: jepsen/src/jepsen/core.clj)
-- control/   remote execution over SSH, daemon helpers
-             (ref: jepsen/src/jepsen/control.clj)
-- nemesis/   fault injection (ref: jepsen/src/jepsen/nemesis.clj)
-- parallel/  device-mesh sharding of the analysis plane (pjit/shard_map)
-- workloads/ reusable generator+checker bundles (ref: jepsen/src/jepsen/tests/)
-- suites/    per-database test suites (ref: etcd/, tidb/, ...)
+- history/       op + history model, columnar int32 tensor view
+                 (ref: knossos op shape; jepsen.txn micro-ops)
+- txn.py         micro-op transaction model (ref: txn/)
+- generator/     pure (v2) generator protocol, combinators, deterministic
+                 simulation harness (ref: jepsen/src/jepsen/generator/pure.clj)
+- runtime/       run() orchestration, Client protocol, workers, crash
+                 cycling, barriers (ref: core.clj, client.clj)
+- checker/       WGL engine (wgl_pallas/wgl_jax/wgl_oracle + models),
+                 O(n) reductions, bank/longfork/adya/causal, timeline,
+                 perf/rate/clock SVG graphs (ref: checker.clj, knossos)
+- independent.py keyed-shard lifting (ref: independent.clj)
+- nemesis.py     fault library: grudges, partitioners, compose, process
+                 faults (ref: nemesis.clj)
+- nemesis_time.py + resources/*.cc   C++ clock tools + clock nemesis
+                 (ref: nemesis/time.clj, resources/*.c)
+- faultfs.py + resources/faultfs.cc  native disk-fault injection
+                 (ref: charybdefs/)
+- faketime.py    rate-skewed clock wrapper (ref: faketime.clj)
+- net.py         Net protocol: iptables/tc + in-process MemNet (ref: net.clj)
+- control/       SSH/local/dummy remotes, sessions, daemon helpers
+                 (ref: control.clj, reconnect.clj, control/util.clj)
+- db.py, os.py   DB/OS automation protocols (ref: db.clj, os/)
+- store.py, web.py, codec.py, report.py   persistence, dashboard, payload
+                 codec, report helpers (ref: store.clj, web.clj, codec.clj)
+- cli.py         test/analyze/serve commands (ref: cli.clj)
+- workloads/     generator+client+checker bundles (ref: jepsen/tests/)
+- suites/        etcd, zookeeper, tidb suite shapes (ref: etcd/, tidb/, ...)
+- utils/         pmaps, timeouts, intervals, XLA profiling hooks (ref: util.clj)
 """
 
 __version__ = "0.1.0"

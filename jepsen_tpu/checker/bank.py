@@ -24,22 +24,54 @@ _NAN = float("nan")
 
 
 @functools.lru_cache(maxsize=1)
-def _bank_reduce():
+def _bank_reduce_device():
     import jax
     import jax.numpy as jnp
 
     @jax.jit
     def reduce(bal, total):
-        """bal [R, A] float32 (NaN = nil); returns per-read flags.
-        All-NaN padding rows report has_nil and are sliced off by the
-        caller."""
+        """bal [R, A] float32 (NaN = nil) -> ONE stacked [4, R] array
+        (has_nil, wrong_total, negative, sums) — a single host
+        round-trip, not four. All-NaN padding rows report has_nil and
+        are sliced off by the caller."""
         has_nil = jnp.any(jnp.isnan(bal), axis=1)
         sums = jnp.where(has_nil, jnp.float32(0), jnp.nansum(bal, axis=1))
         wrong_total = ~has_nil & (sums != total)
         negative = ~has_nil & jnp.any(bal < 0, axis=1)
-        return has_nil, wrong_total, negative, sums
+        return jnp.stack([
+            has_nil.astype(jnp.float32),
+            wrong_total.astype(jnp.float32),
+            negative.astype(jnp.float32),
+            sums,
+        ])
 
     return reduce
+
+
+#: cells above which the reduction moves on-device (below it, the
+#: host<->device round trip costs more than the math)
+_DEVICE_CELLS = 2_000_000
+
+
+def _bank_reduce(bal, total, force_device=None):
+    use_device = force_device if force_device is not None else (
+        bal.size >= _DEVICE_CELLS and _on_tpu()
+    )
+    if use_device:
+        out = np.asarray(_bank_reduce_device()(bal, total))
+        return (out[0] > 0.5, out[1] > 0.5, out[2] > 0.5, out[3])
+    has_nil = np.any(np.isnan(bal), axis=1)
+    with np.errstate(invalid="ignore"):
+        sums = np.where(has_nil, np.float32(0), np.nansum(bal, axis=1))
+        negative = ~has_nil & np.any(bal < 0, axis=1)
+    wrong_total = ~has_nil & (sums != total)
+    return has_nil, wrong_total, negative, sums
+
+
+def _on_tpu() -> bool:
+    from jepsen_tpu.checker.linearizable import _on_tpu as f
+
+    return f()
 
 
 from jepsen_tpu.checker.events import bucket as _bucket
@@ -50,8 +82,10 @@ class BankChecker:
     test map: accounts (default range(8)), total_amount (default 100).
     """
 
-    def __init__(self, negative_balances: bool = False):
+    def __init__(self, negative_balances: bool = False,
+                 force_device=None):
         self.negative_balances = negative_balances
+        self.force_device = force_device
 
     def check(self, test, history, opts=None) -> dict:
         from jepsen_tpu.history.history import History
@@ -90,16 +124,20 @@ class BankChecker:
         # exactly (how clients build them) turn into one row tuple — no
         # per-item indexing.
         acct_tuple = tuple(accounts)
-        bal = np.full((_bucket(max(R, 1)), A), _NAN, np.float32)
+        n_rows = _bucket(max(R, 1))
+        rows: List[Any] = []
+        slow: List[tuple] = []  # (row, op) pairs needing keyed fill
+        zero_row = (0.0,) * A
         for i, op in enumerate(reads):
             v = op.value
             if tuple(v) == acct_tuple:
-                bal[i, :] = tuple(
+                rows.append([
                     _NAN if x is None else x for x in v.values()
-                )
+                ])
                 continue
             unexpected = [k for k in v if k not in acct_idx]
             if unexpected:
+                rows.append([_NAN] * A)  # excluded row
                 record(
                     "unexpected-key", op,
                     unexpected=unexpected, badness=float(len(unexpected)),
@@ -109,13 +147,19 @@ class BankChecker:
             # wrong-total, as in the reference, which sums only the
             # provided balances — bank.clj:58-75); only an explicit
             # nil balance is a nil-balance error.
-            bal[i, :] = 0.0
-            for k, x in v.items():
+            rows.append(list(zero_row))
+            slow.append((i, op))
+        rows.extend([[_NAN] * A] * (n_rows - len(rows)))
+        # One bulk list->array conversion (C speed) instead of a numpy
+        # row-assignment per read.
+        bal = np.asarray(rows, np.float32)
+        for i, op in slow:
+            for k, x in op.value.items():
                 bal[i, acct_idx[k]] = _NAN if x is None else x
 
         if R:
-            has_nil, wrong_total, negative, sums = (
-                np.asarray(x) for x in _bank_reduce()(bal, float(total))
+            has_nil, wrong_total, negative, sums = _bank_reduce(
+                bal, float(total), force_device=self.force_device
             )
             for i in np.nonzero(has_nil[:R])[0]:
                 op = reads[i]

@@ -26,7 +26,7 @@ EXIT_UNKNOWN = 2
 EXIT_CRASH = 254
 EXIT_USAGE = 255
 
-WORKLOADS = ("register", "register-keyed", "bank", "long-fork", "g2")
+WORKLOADS = ("register", "register-keyed", "bank", "long-fork", "g2", "set")
 
 
 def parse_concurrency(spec: str, n_nodes: int) -> int:
@@ -61,6 +61,10 @@ def _workload_spec(args, rng: random.Random) -> Dict[str, Any]:
         return long_fork.workload(n_ops=args.ops, rng=rng)
     if name == "g2":
         return adya.workload(n_keys=max(args.ops // 2, 1))
+    if name == "set":
+        from jepsen_tpu.workloads import set as set_wl
+
+        return set_wl.workload(n_adds=args.ops, rng=rng)
     raise ValueError(f"unknown workload {name!r}")
 
 
@@ -70,9 +74,11 @@ def _checker_for(workload: str):
     from jepsen_tpu.checker.bank import BankChecker
     from jepsen_tpu.checker.linearizable import LinearizableChecker
     from jepsen_tpu.checker.longfork import LongForkChecker
+    from jepsen_tpu.checker.reductions import SetFullChecker
     from jepsen_tpu.workloads.adya import _KVG2Checker
 
     return {
+        "set": SetFullChecker(),
         "register": LinearizableChecker(),
         "register-keyed": independent.independent_checker(
             LinearizableChecker()

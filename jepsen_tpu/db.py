@@ -47,6 +47,15 @@ def cycle(test) -> None:
     db: DB = test["db"]
     tries = CYCLE_TRIES
     while True:
+        # A failed previous attempt may have left waiters timing out on
+        # the setup barrier; a broken Barrier stays broken until reset.
+        barrier = test.get("barrier")
+        if barrier is not None:
+            try:
+                barrier.reset()
+            except Exception:
+                pass
+
         def teardown_one(node, sess):
             try:
                 db.teardown(test, node, sess)

@@ -23,8 +23,16 @@ from jepsen_tpu.nemesis import Nemesis
 
 TOOL_DIR = "/opt/jepsen-tpu"
 SO_PATH = f"{TOOL_DIR}/faultfs.so"
-CONF_PATH = f"{TOOL_DIR}/faultfs.conf"
 _RES = os.path.join(os.path.dirname(__file__), "resources")
+
+
+def conf_path(prefix: str) -> str:
+    """Per-prefix config file, so two daemons afflicted on different
+    directories stay independently controllable."""
+    import hashlib
+
+    tag = hashlib.sha256(prefix.encode()).hexdigest()[:12]
+    return f"{TOOL_DIR}/faultfs-{tag}.conf"
 
 
 def install(session: Session) -> None:
@@ -44,7 +52,7 @@ def env_for(prefix: str) -> Dict[str, str]:
     pass to control.util.start_daemon(env=...)."""
     return {
         "LD_PRELOAD": SO_PATH,
-        "JEPSEN_FAULTFS_CONF": CONF_PATH,
+        "JEPSEN_FAULTFS_CONF": conf_path(prefix),
     }
 
 
@@ -60,7 +68,9 @@ def write_config(
         f"prefix={prefix}\nmode={mode}\nerrno={err}\n"
         f"probability={probability}\ndelay_us={delay_us}\n"
     )
-    session.exec("sh", "-c", f"cat > {CONF_PATH}", stdin=conf)
+    session.exec(
+        "sh", "-c", f"cat > {conf_path(prefix)}", stdin=conf
+    )
 
 
 class FaultFSNemesis(Nemesis):

@@ -68,7 +68,7 @@ struct Config {
 };
 
 Config cfg = {"", 0, EIO, 0, 0};
-time_t cfg_mtime = 0;
+long long cfg_stamp = -1;
 const char *cfg_path = nullptr;
 unsigned int rng_state = 12345;
 
@@ -97,8 +97,13 @@ void reload_config() {
     cfg.mode = 0;
     return;
   }
-  if (st.st_mtime == cfg_mtime) return;
-  cfg_mtime = st.st_mtime;
+  // Nanosecond + size keyed: two config flips within the same second
+  // must not be coalesced (a long-lived DB process would keep the old
+  // fault mode).
+  long long stamp = (long long)st.st_mtime * 1000000000LL +
+                    st.st_mtim.tv_nsec + st.st_size;
+  if (stamp == cfg_stamp) return;
+  cfg_stamp = stamp;
   // Use the REAL calls so config reads never recurse into the shim.
   int fd = real_open(cfg_path, O_RDONLY);
   if (fd < 0) return;
@@ -133,16 +138,24 @@ void reload_config() {
   cfg = nc;
 }
 
-bool path_afflicted(const char *path) {
+// Does this path fall under the configured prefix? Independent of the
+// CURRENT mode: fds opened while faults are off must still be tracked,
+// so a later mode flip afflicts the DB's long-lived WAL/data fds.
+bool path_in_prefix(const char *path) {
   reload_config();
-  if (cfg.mode == 0 || !cfg.prefix[0] || !path) return false;
+  if (!cfg.prefix[0] || !path) return false;
   return strncmp(path, cfg.prefix, strlen(cfg.prefix)) == 0;
 }
 
+bool path_afflicted(const char *path) {
+  return path_in_prefix(path) && cfg.mode != 0;
+}
+
 // Should THIS operation on an afflicted fd fault?  Returns errno to
-// inject, or 0 to pass through (possibly after a delay).
+// inject, or 0 to pass through (possibly after a delay). Callers have
+// just run reload_config() via path_afflicted()/is_afflicted(), so the
+// config is fresh — no second stat here.
 int roll() {
-  reload_config();
   switch (cfg.mode) {
     case 1:
       return cfg.err;
@@ -158,7 +171,7 @@ int roll() {
 }
 
 void track(int fd, const char *path) {
-  if (fd >= 0 && fd < MAX_FDS) afflicted[fd] = path_afflicted(path);
+  if (fd >= 0 && fd < MAX_FDS) afflicted[fd] = path_in_prefix(path);
 }
 
 bool is_afflicted(int fd) {

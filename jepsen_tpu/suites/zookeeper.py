@@ -105,7 +105,9 @@ class ZkCliClient(Client):
                     self._zk(test, "set", path, str(new), str(version))
                     return op.with_(type="ok")
                 except RemoteError as e:
-                    if "BadVersion" in (e.out + e.err + str(e)):
+                    blob = e.out + str(e.err) + str(e)
+                    if "BadVersion" in blob or \
+                            "version No is not valid" in blob:
                         return op.with_(type="fail")
                     raise
             raise ValueError(f"unknown op f={op.f!r}")

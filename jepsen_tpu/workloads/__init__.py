@@ -7,6 +7,6 @@ map slots to merge into a test spec, plus an in-memory client so the
 whole stack runs (and is tested) with zero I/O.
 """
 
-from jepsen_tpu.workloads import adya, bank, long_fork, register
+from jepsen_tpu.workloads import adya, bank, long_fork, register, set
 
-__all__ = ["adya", "bank", "long_fork", "register"]
+__all__ = ["adya", "bank", "long_fork", "register", "set"]

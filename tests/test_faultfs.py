@@ -90,10 +90,55 @@ def test_nemesis_config_shapes():
     # targeted subset
     out = nem.invoke(test, invoke_op("nemesis", "start", {"n2": None}))
     assert list(out.value) == ["n2"]
-    # config writes go through cat > conf with stdin
-    assert any("faultfs.conf" in c for c in remote.commands("n1"))
+    # config writes go through cat > the per-prefix conf with stdin
+    assert any("faultfs-" in c and ".conf" in c
+               for c in remote.commands("n1"))
+    assert faultfs.conf_path("/a") != faultfs.conf_path("/b")
 
 
 def test_env_for():
     env = faultfs.env_for("/var/lib/db")
     assert env["LD_PRELOAD"].endswith("faultfs.so")
+
+
+def test_shim_afflicts_fds_opened_before_fault_flip(shim):
+    # The DB lifecycle: files open while faults are OFF, then the
+    # nemesis flips mode=fail — the already-open fd must start failing
+    # (and recover on clear), within the same long-lived process.
+    _conf(shim, mode="none")
+    script = f"""
+import os, sys, time
+fd = os.open({os.path.join(shim['data'], 'file')!r}, os.O_RDONLY)
+print("opened", flush=True)
+sys.stdin.readline()          # wait for fault flip
+try:
+    os.pread(fd, 4, 0)
+    print("read-ok", flush=True)
+except OSError as e:
+    print("read-err", e.errno, flush=True)
+sys.stdin.readline()          # wait for clear
+try:
+    os.pread(fd, 4, 0)
+    print("read-ok2", flush=True)
+except OSError as e:
+    print("read-err2", e.errno, flush=True)
+"""
+    import time
+
+    p = subprocess.Popen(
+        ["python3", "-c", script],
+        env={**os.environ,
+             "LD_PRELOAD": shim["so"],
+             "JEPSEN_FAULTFS_CONF": shim["conf"]},
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+    )
+    assert p.stdout.readline().strip() == "opened"
+    time.sleep(0.05)
+    _conf(shim, mode="fail", errno=errno.EIO)
+    p.stdin.write("\n"); p.stdin.flush()
+    assert p.stdout.readline().strip() == f"read-err {errno.EIO}"
+    time.sleep(0.05)
+    _conf(shim, mode="none")
+    p.stdin.write("\n"); p.stdin.flush()
+    assert p.stdout.readline().strip() == "read-ok2"
+    p.wait(5)

@@ -126,3 +126,92 @@ def test_zkcli_client_command_shapes():
     # cas with stale expectation fails cleanly
     o = c.invoke(test, invoke_op(0, "cas", independent.KV(7, (4, 9))))
     assert o.type == "fail"
+
+
+# -- tidb structured suite ---------------------------------------------------
+
+from jepsen_tpu.suites import tidb
+
+
+def test_tidb_db_multiphase_setup_commands():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2", "n3"], "remote": remote,
+            "barrier": None, "tarball": "http://x/tidb.tar.gz"}
+    db = tidb.TidbDB()
+    sess = sessions_for(test)
+    db.setup(test, "n2", sess["n2"])
+    cmds = remote.commands("n2")
+    assert any("pd-server" in c and "--initial-cluster=pd1=http://n1:2380"
+               in c for c in cmds)
+    assert any("tikv-server" in c and "--pd=n1:2379,n2:2379,n3:2379" in c
+               for c in cmds)
+    assert any("tidb-server" in c for c in cmds)
+    db.teardown(test, "n2", sess["n2"])
+    assert any("db.pid" in c for c in remote.commands("n2"))
+
+
+def test_tidb_process_nemesis_routes_components():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2", "n3"], "remote": remote}
+    nem_ = tidb.ProcessNemesis(rng=random.Random(1))
+    from jepsen_tpu.history.ops import invoke_op
+
+    out = nem_.invoke(test, invoke_op("nemesis", "pause-kv"))
+    assert out.type == "info"
+    assert all(v == "paused" for v in out.value.values())
+    paused_nodes = list(out.value)
+    assert any("killall -s STOP tikv-server" in c
+               for n in paused_nodes for c in remote.commands(n))
+    out = nem_.invoke(test, invoke_op("nemesis", "resume-kv"))
+    assert sorted(out.value) == ["n1", "n2", "n3"]  # resumes hit all
+    out = nem_.invoke(test, invoke_op("nemesis", "kill-db"))
+    assert all(v == "killed" for v in out.value.values())
+
+
+def test_tidb_full_nemesis_composes_all_fault_families():
+    remote = DummyRemote(responses={"date +%s.%N": (0, "0.0\n", "")})
+    from jepsen_tpu import net as netlib
+    from jepsen_tpu.history.ops import invoke_op
+
+    test = {"nodes": ["n1", "n2"], "remote": remote,
+            "net": netlib.MemNet()}
+    nem_ = tidb.full_nemesis(rng=random.Random(2))
+    out = nem_.invoke(test, invoke_op("nemesis", "kill-kv"))
+    assert out.f == "kill-kv" and out.type == "info"
+    out = nem_.invoke(test, invoke_op("nemesis", "start-partition"))
+    assert out.f == "start-partition"
+    assert not test["net"].allows("n1", "n2")
+    out = nem_.invoke(test, invoke_op("nemesis", "stop-partition"))
+    assert test["net"].allows("n1", "n2")
+    out = nem_.invoke(
+        test, invoke_op("nemesis", "bump-clock", {"n1": 5000})
+    )
+    assert out.f == "bump-clock"
+    assert any("bump_time 5000" in c for c in remote.commands("n1"))
+
+
+def test_tidb_workload_matrix_expansion():
+    opts = tidb.all_test_options()
+    names = {o["workload"] for o in opts}
+    assert names == {"bank", "register", "long-fork"}
+    regs = [o for o in opts if o["workload"] == "register"]
+    assert {o["keys"] for o in regs} == {4, 8}  # axis expanded
+
+
+def test_tidb_dummy_suite_end_to_end():
+    test = tidb.tidb_test({
+        "dummy": True,
+        "workload": "bank",
+        "nemesis": "partitions",
+        "nemesis_interval": 0.05,
+        "time_limit": 2.0,
+        "ops": 150,
+        "rng": random.Random(4),
+    })
+    test["nodes"] = ["n1", "n2", "n3", "n4"]
+    test["concurrency"] = 4
+    test = run(test)
+    assert test["results"]["valid?"] is True
+    nem_ops = [o.f for o in test["history"].ops
+               if o.process == "nemesis" and o.type == "info"]
+    assert "start-partition" in nem_ops

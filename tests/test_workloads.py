@@ -344,3 +344,35 @@ def test_keyed_register_workload_end_to_end():
     test = run({**spec, "concurrency": 4})
     assert test["results"]["valid?"] is True
     assert test["results"]["key_count"] == 4
+
+
+def test_bank_device_host_parity():
+    import random as _random
+
+    from jepsen_tpu.sim import gen_bank_history
+
+    h = gen_bank_history(_random.Random(8), n_ops=400, torn=True)
+    test = {"accounts": list(range(8)), "total_amount": 100}
+    a = BankChecker(force_device=False).check(test, h)
+    b = BankChecker(force_device=True).check(test, h)
+    assert a == b
+    assert a["valid?"] is False
+
+
+# -- set workload ------------------------------------------------------------
+
+
+def test_set_workload_honest_and_lossy():
+    from jepsen_tpu.workloads import set as set_wl
+
+    spec = set_wl.workload(n_adds=120, rng=random.Random(5))
+    test = run({**spec, "concurrency": 4})
+    assert test["results"]["valid?"] is True
+    assert test["results"]["lost-count"] == 0
+
+    spec = set_wl.workload(
+        n_adds=200, rng=random.Random(6), lossy=0.3
+    )
+    test = run({**spec, "concurrency": 4})
+    assert test["results"]["valid?"] is False
+    assert test["results"]["lost-count"] > 0
