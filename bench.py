@@ -148,16 +148,32 @@ def bench_config4():
     tpu_wall, r = _time(lambda: checker.check({}, h))
     assert r["valid?"] is True, r
 
+    # Baseline mirrors the reference checker's actual reduce
+    # (adya.clj:62-88): per-key ok counts for every insert (not just
+    # ok ones), the illegal sorted map, and the legal count.
     def loop_check():
         counts = {}
         for op in h.ops:
-            if op.f == "insert" and op.type == "ok":
-                k = op.value[0]
+            if op.f != "insert":
+                continue
+            k = op.value[0]
+            if op.type == "ok":
                 counts[k] = counts.get(k, 0) + 1
-        return all(c <= 1 for c in counts.values())
+            else:
+                counts.setdefault(k, 0)
+        illegal = dict(sorted(
+            (k, c) for k, c in counts.items() if c > 1
+        ))
+        insert_count = sum(1 for c in counts.values() if c > 0)
+        return {
+            "valid?": not illegal,
+            "key_count": len(counts),
+            "legal_count": insert_count - len(illegal),
+            "illegal": illegal,
+        }
 
     oracle_wall, want = _time(loop_check)
-    assert want is True
+    assert want == {k: r[k] for k in want}, (want, r)
     return {
         "name": "g2-100k",
         "n_ops": len(h.ops) // 2,

@@ -29,12 +29,12 @@ class G2Checker:
             history = History(list(history))
         counts: Dict[Any, int] = {}
         for o in history.ops:
-            if o.f != "insert" or not isinstance(
-                o.value, (list, tuple)
-            ) or len(o.value) != 2:
+            v = o.value
+            if o.f != "insert" or not isinstance(v, (list, tuple)) \
+                    or len(v) != 2:
                 continue
-            k = o.value[0]
-            if o.is_ok:
+            k = v[0]
+            if o.type == "ok":
                 counts[k] = counts.get(k, 0) + 1
             else:
                 counts.setdefault(k, 0)

@@ -7,6 +7,6 @@ shared CLI, following the canonical 197-line etcd shape
 (etcd/src/jepsen/etcd.clj:149-188).
 """
 
-from jepsen_tpu.suites import etcd, tidb, zookeeper
+from jepsen_tpu.suites import consul, etcd, tidb, zookeeper
 
-__all__ = ["etcd", "tidb", "zookeeper"]
+__all__ = ["consul", "etcd", "tidb", "zookeeper"]

@@ -165,11 +165,12 @@ def zookeeper_test(opts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
 
     from jepsen_tpu.workloads.register import op_mix
 
+    per_key_limit = opts.pop("per_key_limit", 200)
     client_gen = independent.concurrent_generator(
         opts.pop("threads_per_key", 2),
         list(range(opts.pop("keys", 16))),
         lambda k: gen.limit(
-            opts.get("per_key_limit", 200),
+            per_key_limit,
             gen.stagger(1 / 50, op_mix(rng), rng=rng),
         ),
     )

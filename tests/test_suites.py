@@ -215,3 +215,37 @@ def test_tidb_dummy_suite_end_to_end():
     nem_ops = [o.f for o in test["history"].ops
                if o.process == "nemesis" and o.type == "info"]
     assert "start-partition" in nem_ops
+
+
+# -- consul suite ------------------------------------------------------------
+
+from jepsen_tpu.suites import consul
+
+
+def test_consul_dummy_suite():
+    test = consul.consul_test({
+        "dummy": True,
+        "keys": 2,
+        "per_key_limit": 10,
+        "threads_per_key": 2,
+        "time_limit": 5.0,
+        "rng": random.Random(8),
+    })
+    test["nodes"] = ["n1", "n2", "n3"]
+    test["concurrency"] = 4
+    test = run(test)
+    assert test["results"]["valid?"] is True
+
+
+def test_consul_db_commands():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2", "n3"], "remote": remote}
+    db = consul.ConsulDB()
+    sess = sessions_for(test)
+    db.setup(test, "n1", sess["n1"])   # primary: bootstrap
+    db.setup(test, "n2", sess["n2"])   # follower: retry-join
+    c1 = remote.commands("n1")
+    c2 = remote.commands("n2")
+    assert any("-bootstrap-expect=3" in c for c in c1)
+    assert not any("-retry-join" in c for c in c1)
+    assert any("-retry-join=n1" in c for c in c2)
