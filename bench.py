@@ -38,26 +38,65 @@ def _time(fn, reps=1):
 
 
 def bench_config1():
-    """etcd 1k-op single-key CAS register."""
+    """etcd 1k-op single-key CAS register histories.
+
+    One history is RECORDED by the actual runtime (in-memory register
+    workload through run() — real workers, real crash-cycling), the
+    rest simulated; the TPU number is batch throughput over 8 such
+    histories in ONE kernel launch + sync (the realistic way to use an
+    accelerator, and the only honest one under this environment's
+    ~100ms host-device round-trip floor, which otherwise dominates any
+    single 1k-op check). Per-check latency is reported alongside.
+    """
+    import jepsen_tpu.generator.pure as gen
     from jepsen_tpu.checker.events import history_to_events
     from jepsen_tpu.checker.linearizable import check_events_bucketed
+    from jepsen_tpu.checker.sharded import check_keys
     from jepsen_tpu.checker.wgl_oracle import check_events as oracle
+    from jepsen_tpu.runtime import AtomClient, run
     from jepsen_tpu.sim import gen_register_history
+    from jepsen_tpu.workloads.register import op_mix
 
-    h = gen_register_history(
-        random.Random(42), n_ops=1000, n_procs=5, p_crash=0.01
+    rng = random.Random(42)
+    recorded = run({
+        "name": "bench-etcd",
+        "client": AtomClient(),
+        "generator": gen.clients(gen.limit(
+            1000, gen.stagger(1 / 5000, op_mix(rng), rng=rng)
+        )),
+        "concurrency": 5,
+    })["history"]
+    streams = [history_to_events(recorded)]
+    for seed in range(7):
+        h = gen_register_history(
+            random.Random(100 + seed), n_ops=1000, n_procs=5,
+            p_crash=0.01,
+        )
+        streams.append(history_to_events(h))
+    n_ops = sum(s.n_ops for s in streams)
+
+    check_keys(streams)  # warmup/compile
+    check_events_bucketed(streams[1])  # warmup the single-check shape
+    tpu_wall, results = _time(lambda: check_keys(streams))
+    single_wall, r1 = _time(
+        lambda: check_events_bucketed(streams[1]), reps=3
     )
-    ev = history_to_events(h)
-    r = check_events_bucketed(ev)  # warmup/compile
-    tpu_wall, r = _time(lambda: check_events_bucketed(ev), reps=5)
-    oracle_wall, want = _time(lambda: oracle(ev))
-    assert r["valid?"] == want is True, (r, want)
+    t0 = time.perf_counter()
+    wants = [oracle(s) for s in streams]
+    oracle_wall = time.perf_counter() - t0
+    for r, want in zip(results, wants):
+        assert r["valid?"] == want is True, (r, want)
+    print(
+        f"etcd-1k single-check latency: {single_wall:.3f}s "
+        f"({r1['method']}; ~0.1s of that is the tunnel round trip)",
+        file=sys.stderr,
+    )
     return {
         "name": "etcd-1k",
-        "n_ops": ev.n_ops,
+        "n_ops": n_ops,
         "tpu_wall": tpu_wall,
         "oracle_wall": oracle_wall,
-        "method": r["method"],
+        "method": results[0]["method"] + " x8 batch, 1 recorded",
     }
 
 

@@ -26,10 +26,16 @@ def start_daemon(
     logfile (start-daemon!, control/util.clj:208-236). Uses setsid +
     shell backgrounding rather than start-stop-daemon so it works on
     any POSIX host."""
+    import shlex
+
     envs = " ".join(
-        f"{k}={v}" for k, v in (env or {}).items()
+        f"{k}={shlex.quote(str(v))}" for k, v in (env or {}).items()
     )
-    cmdline = " ".join([envs, binary, *[str(a) for a in args]]).strip()
+    # Each argument shell-quoted: daemon args may carry spaces or
+    # template braces (e.g. consul's go-sockaddr '-bind {{ GetPrivateIP }}').
+    cmdline = " ".join(
+        [envs, shlex.quote(binary), *[shlex.quote(str(a)) for a in args]]
+    ).strip()
     script = (
         f"setsid {cmdline} >> {logfile} 2>&1 < /dev/null & "
         f"echo $! > {pidfile}"

@@ -65,6 +65,12 @@ class ConsulDB(DB):
         start_daemon(
             session, BINARY, *args, pidfile=PIDFILE, logfile=LOGFILE,
         )
+        import time
+
+        # Leader election under bootstrap-expect takes a few seconds;
+        # invoking before it completes just fills the history head with
+        # indeterminate ops (same wait as EtcdDB.setup).
+        time.sleep(test.get("db_start_wait", 5))
 
     def teardown(self, test, node, session):
         stop_daemon(session, PIDFILE)
