@@ -28,14 +28,20 @@ def start_daemon(
     any POSIX host."""
     import shlex
 
-    envs = " ".join(
-        f"{k}={shlex.quote(str(v))}" for k, v in (env or {}).items()
+    # Env rides through env(1): `setsid K=V prog` would execvp the
+    # assignment string itself as the program.
+    envs = (
+        "env " + " ".join(
+            f"{k}={shlex.quote(str(v))}" for k, v in env.items()
+        ) + " "
+        if env
+        else ""
     )
     # Each argument shell-quoted: daemon args may carry spaces or
     # template braces (e.g. consul's go-sockaddr '-bind {{ GetPrivateIP }}').
-    cmdline = " ".join(
-        [envs, shlex.quote(binary), *[shlex.quote(str(a)) for a in args]]
-    ).strip()
+    cmdline = envs + " ".join(
+        [shlex.quote(binary), *[shlex.quote(str(a)) for a in args]]
+    )
     script = (
         f"setsid {cmdline} >> {logfile} 2>&1 < /dev/null & "
         f"echo $! > {pidfile}"

@@ -237,13 +237,42 @@ class IndependentChecker:
             subhistories.setdefault(v.key, []).append(
                 op.with_(value=v.value)
             )
+        # Per-key artifacts (independent.clj:266-288 writes each key's
+        # results + history under independent/<key>/): mirror that when
+        # the test has a run directory.
+        run_dir = (opts or {}).get("subdirectory") or (
+            test.get("run_dir") if isinstance(test, dict) else None
+        )
         results = {}
         any_false = any_unknown = False
         for k, ops in sorted(
             subhistories.items(), key=lambda kv: str(kv[0])
         ):
-            r = self.checker.check(test, History(ops), opts)
+            sub = History(ops)
+            sub_opts = dict(opts or {})
+            key_dir = None
+            if run_dir:
+                import os
+
+                key_dir = os.path.join(run_dir, "independent", str(k))
+                os.makedirs(key_dir, exist_ok=True)
+                sub_opts["subdirectory"] = key_dir
+            r = self.checker.check(test, sub, sub_opts)
             results[k] = r
+            if key_dir:
+                import os
+
+                from jepsen_tpu.store import (
+                    write_history_jsonl,
+                    write_results_json,
+                )
+
+                write_results_json(
+                    os.path.join(key_dir, "results.json"), r
+                )
+                write_history_jsonl(
+                    os.path.join(key_dir, "history.jsonl"), sub.ops
+                )
             v = r.get("valid?")
             if v is False:
                 any_false = True

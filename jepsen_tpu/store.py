@@ -124,6 +124,20 @@ def op_from_json(d: dict) -> Op:
     )
 
 
+def write_history_jsonl(path: str, ops: Iterable[Op]) -> None:
+    """One op per JSON line — THE history file format (used by Store
+    and by per-key artifact writers)."""
+    with open(path, "w") as f:
+        for op in ops:
+            f.write(json.dumps(op_to_json(op), default=str))
+            f.write("\n")
+
+
+def write_results_json(path: str, results: Any) -> None:
+    with open(path, "w") as f:
+        json.dump(_encode_value(results), f, indent=2, default=str)
+
+
 class Store:
     """A run-directory store rooted at `root` (default ./store)."""
 
@@ -172,18 +186,17 @@ class Store:
             json.dump(_encode_value(clean), f, indent=2, default=str)
         history: Optional[History] = test.get("history")
         if history is not None:
-            with open(os.path.join(d, "history.jsonl"), "w") as f:
-                for op in history.ops:
-                    f.write(json.dumps(op_to_json(op), default=str))
-                    f.write("\n")
+            write_history_jsonl(
+                os.path.join(d, "history.jsonl"), history.ops
+            )
         return d
 
     def save_2(self, test: Dict[str, Any]) -> str:
         """Phase 2, after analysis: results."""
         d = test.get("run_dir") or self.make_run_dir(test)
-        with open(os.path.join(d, "results.json"), "w") as f:
-            json.dump(_encode_value(test.get("results")), f, indent=2,
-                      default=str)
+        write_results_json(
+            os.path.join(d, "results.json"), test.get("results")
+        )
         return d
 
     # -- load (store.clj:177-300) -----------------------------------------

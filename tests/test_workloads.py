@@ -376,3 +376,23 @@ def test_set_workload_honest_and_lossy():
     test = run({**spec, "concurrency": 4})
     assert test["results"]["valid?"] is False
     assert test["results"]["lost-count"] > 0
+
+
+def test_independent_checker_writes_per_key_artifacts(tmp_path):
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+
+    KV = independent.KV
+    h = History([
+        invoke_op(0, "write", KV("a", 1)), ok_op(0, "write", KV("a", 1)),
+        invoke_op(1, "write", KV("b", 2)), ok_op(1, "write", KV("b", 2)),
+    ])
+    r = independent.independent_checker(LinearizableChecker()).check(
+        {"run_dir": str(tmp_path)}, h
+    )
+    assert r["valid?"] is True
+    import os
+
+    for k in ("a", "b"):
+        d = tmp_path / "independent" / k
+        assert (d / "results.json").exists()
+        assert (d / "history.jsonl").exists()
