@@ -240,9 +240,29 @@ class IndependentChecker:
         # Per-key artifacts (independent.clj:266-288 writes each key's
         # results + history under independent/<key>/): mirror that when
         # the test has a run directory.
+        import os
+        import urllib.parse
+
+        from jepsen_tpu.store import (
+            write_history_jsonl,
+            write_results_json,
+        )
+
         run_dir = (opts or {}).get("subdirectory") or (
             test.get("run_dir") if isinstance(test, dict) else None
         )
+        used_names: Dict[str, int] = {}
+
+        def key_dirname(k) -> str:
+            # Percent-encode (no separators), uniquify colliding str()
+            # forms (e.g. int 1 vs str "1"), and guard the dot names
+            # quote() leaves unescaped.
+            name = urllib.parse.quote(str(k), safe="")
+            if name in ("", ".", ".."):
+                name = f"k_{name.replace('.', '_')}"
+            n = used_names.get(name, 0)
+            used_names[name] = n + 1
+            return name if n == 0 else f"{name}~{n}"
         results = {}
         any_false = any_unknown = False
         for k, ops in sorted(
@@ -252,21 +272,14 @@ class IndependentChecker:
             sub_opts = dict(opts or {})
             key_dir = None
             if run_dir:
-                import os
-
-                key_dir = os.path.join(run_dir, "independent", str(k))
+                key_dir = os.path.join(
+                    run_dir, "independent", key_dirname(k)
+                )
                 os.makedirs(key_dir, exist_ok=True)
                 sub_opts["subdirectory"] = key_dir
             r = self.checker.check(test, sub, sub_opts)
             results[k] = r
             if key_dir:
-                import os
-
-                from jepsen_tpu.store import (
-                    write_history_jsonl,
-                    write_results_json,
-                )
-
                 write_results_json(
                     os.path.join(key_dir, "results.json"), r
                 )

@@ -87,7 +87,13 @@ void init_real() {
   real_fdatasync = (fsync_t)dlsym(RTLD_NEXT, "fdatasync");
   real_close = (close_t)dlsym(RTLD_NEXT, "close");
   cfg_path = getenv("JEPSEN_FAULTFS_CONF");
-  rng_state = (unsigned int)getpid() * 2654435761u + 1;
+  // Seed from pid AND the clock: consecutive pids alone give rand_r
+  // correlated first draws, which biases flaky-mode fault rates for
+  // fleets of short-lived processes.
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  rng_state = (unsigned int)getpid() * 2654435761u ^
+              (unsigned int)ts.tv_nsec;
 }
 
 void reload_config() {

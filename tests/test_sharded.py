@@ -53,3 +53,54 @@ def test_graft_entry_contract():
     assert bool(alive) is True
     assert int(died) == -1
     g.dryrun_multichip(8)
+
+
+def test_sharded_at_scale_with_escalation_keys():
+    # VERDICT weak #7: the per-key overflow-escalation branch and
+    # larger key counts. 48 keys across the 8-device mesh, including
+    # crash-heavy keys whose first-rung frontier overflows and must
+    # re-check individually through the ladder — verdicts must still
+    # match the oracle on every key.
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = Mesh(np.asarray(devs[:8]), axis_names=("keys",))
+    streams = []
+    for seed in range(48):
+        rng = random.Random(9000 + seed)
+        crashy = seed % 6 == 0
+        h = gen_register_history(
+            rng, n_ops=40, n_procs=4,
+            p_crash=0.3 if crashy else 0.02,
+        )
+        if seed % 4 == 0:
+            h = corrupt_history(h, rng)
+        streams.append(history_to_events(h))
+    results = check_keys(streams, mesh=mesh, k_ladder=(2, 128))
+    assert len(results) == 48
+    n_escalated = 0
+    for i, (s, r) in enumerate(zip(streams, results)):
+        assert r["valid?"] == oracle_check(s), f"key {i}: {r}"
+        # keys that left the sharded batch re-checked individually
+        # through the ladder (their method is the single-key one)
+        if r["method"] != "tpu-wgl-sharded":
+            n_escalated += 1
+    # the tiny first rung guarantees some keys actually escalated
+    assert n_escalated >= 1
+
+
+def test_batch_path_escalation_on_one_device():
+    # Same shape through the single-device batched path (no mesh).
+    streams = []
+    for seed in range(24):
+        rng = random.Random(9500 + seed)
+        h = gen_register_history(
+            rng, n_ops=40, n_procs=4,
+            p_crash=0.3 if seed % 5 == 0 else 0.02,
+        )
+        if seed % 3 == 0:
+            h = corrupt_history(h, rng)
+        streams.append(history_to_events(h))
+    results = check_keys(streams, k_ladder=(2, 128))
+    for i, (s, r) in enumerate(zip(streams, results)):
+        assert r["valid?"] == oracle_check(s), f"key {i}: {r}"
