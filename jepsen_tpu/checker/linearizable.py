@@ -97,6 +97,14 @@ def _bucket_events(n: int) -> int:
     return bucket(n, 64)
 
 
+def _bitset_plan(events: EventStream, m) -> Optional[tuple]:
+    """(W, S) for the exact bitset kernel, or None when the stream is
+    outside its envelope (window, state rows, or model shape)."""
+    from jepsen_tpu.checker import wgl_bitset as bs
+
+    return bs.plan(m, events.window, len(events.value_codes))
+
+
 def check_events_bucketed(
     events: EventStream,
     model: str = "cas-register",
@@ -104,13 +112,38 @@ def check_events_bucketed(
 ) -> dict:
     """Definite linearizability verdict for an event stream.
 
-    Returns {"valid?": bool, "method": "tpu-wgl"|"cpu-oracle",
-             "frontier_k": K or None, "escalations": int}.
+    Returns {"valid?": bool, "method": "tpu-wgl-bitset"|"tpu-wgl"|
+             "cpu-oracle", "frontier_k": K or None, "escalations": int}.
     """
     from jepsen_tpu.checker.models import model as get_model
 
     W = _bucket_window(max(events.window, 1))
     m = get_model(model)
+
+    # Exact bitset kernel first: for windows <= 16 and small state
+    # spaces it holds the ENTIRE config space, so its verdict is always
+    # definite — no escalation ladder, no oracle fallback (wgl_bitset
+    # module docstring). taint is impossible by construction; if it ever
+    # fires, fall through to the capacity-ladder paths below.
+    plan = _bitset_plan(events, m) if _on_tpu() else None
+    if plan is not None:
+        from jepsen_tpu.checker.events import events_to_steps as _ets
+        from jepsen_tpu.checker.wgl_bitset import check_steps_bitset
+
+        bW, S = plan
+        bsteps = _ets(events, W=bW)
+        bsteps = bsteps.padded(_bucket_events(max(len(bsteps), 1)))
+        alive, taint, died = check_steps_bitset(bsteps, model=model, S=S)
+        if not taint:
+            out = {
+                "valid?": alive,
+                "method": "tpu-wgl-bitset",
+                "frontier_k": None,
+                "escalations": 0,
+            }
+            if not alive:
+                out["failed_op_index"] = died
+            return out
     if W is None or not m.jax_capable:
         # Too concurrent for the masks, or the model's state doesn't
         # fit a machine word (queue multisets): the oracle decides.

@@ -88,6 +88,35 @@ def register_step_jax(state, f, a, b):
     return ok, state2
 
 
+# -- bitset-kernel slot transitions ------------------------------------------
+#
+# The bitset WGL kernel (wgl_bitset.py) represents the frontier as a
+# [S, 2^W] bit tensor over (state-row, linearized-mask) configs, with
+# state row = value code + 1 (NIL -> row 0). Every register-family /
+# mutex transition has the shape "one source row (or the union of all
+# rows) maps to one destination row", so a model describes a slot's op
+# (f, a, b) with four scalars:
+#
+#   (src_is_union, src_row, dst_row, valid)
+#
+# src_is_union: the op linearizes from ANY state (write); otherwise only
+# from src_row (read/cas: the allowed state). dst_row is the state row
+# after linearization. valid=False means f is outside the model (e.g.
+# cas under plain "register") and the slot never linearizes.
+
+
+def cas_register_bitset_slot(f, a, b):
+    is_write = f == F_WRITE
+    is_cas = f == F_CAS
+    dst = jnp.where(is_cas, b, a) + 1
+    return is_write, a + 1, dst, f == f
+
+
+def register_bitset_slot(f, a, b):
+    is_write = f == F_WRITE
+    return is_write, a + 1, a + 1, f != F_CAS
+
+
 class Model:
     """A named model: python + jax step functions over int32 codes, plus
     the op.f -> f-code mapping used when encoding histories.
@@ -109,6 +138,8 @@ class Model:
         jax_capable: bool = True,
         initial: Optional[Callable[[int], Any]] = None,
         crashed_droppable_fs: Tuple[int, ...] = (),
+        bitset_slot_jax: Optional[Callable] = None,
+        bitset_rows: Optional[Callable[[int], int]] = None,
     ):
         self.name = name
         self.step_py = step_py
@@ -117,6 +148,17 @@ class Model:
         self.jax_capable = jax_capable
         self._initial = initial
         self.crashed_droppable_fs = frozenset(crashed_droppable_fs)
+        #: slot transition for the exact bitset kernel (None = the model
+        #: can't run on it; see cas_register_bitset_slot)
+        self.bitset_slot_jax = bitset_slot_jax
+        #: state rows the bitset frontier needs for a history with n
+        #: interned value codes (row 0 is NIL)
+        self._bitset_rows = bitset_rows
+
+    def bitset_rows(self, n_value_codes: int) -> int:
+        if self._bitset_rows is not None:
+            return self._bitset_rows(n_value_codes)
+        return n_value_codes + 1
 
     def initial(self, init_code: int):
         """The model's initial configuration state for an interned
@@ -164,6 +206,13 @@ def mutex_step_jax(state, f, a, b):
     return ok, state2
 
 
+def mutex_bitset_slot(f, a, b):
+    is_acq = f == F_ACQUIRE
+    src = jnp.where(is_acq, 0, 1) + 1
+    dst = jnp.where(is_acq, 1, 0) + 1
+    return f != f, src, dst, f == f
+
+
 # -- unordered queue (knossos model/unordered-queue) -------------------------
 
 F_ENQ, F_DEQ = 0, 1
@@ -197,14 +246,18 @@ MODELS: Dict[str, Model] = {
     "cas-register": Model(
         "cas-register", cas_register_step_py, cas_register_step_jax,
         F_NAMES, crashed_droppable_fs=(F_READ,),
+        bitset_slot_jax=cas_register_bitset_slot,
     ),
     "register": Model(
         "register", register_step_py, register_step_jax, F_NAMES,
         crashed_droppable_fs=(F_READ,),
+        bitset_slot_jax=register_bitset_slot,
     ),
     "mutex": Model(
         "mutex", mutex_step_py, mutex_step_jax, MUTEX_F_NAMES,
         initial=lambda init_code: 0,
+        bitset_slot_jax=mutex_bitset_slot,
+        bitset_rows=lambda n: 3,
     ),
     "unordered-queue": Model(
         "unordered-queue", unordered_queue_step_py, None, QUEUE_F_NAMES,

@@ -178,6 +178,36 @@ def check_keys(
         from jepsen_tpu.checker.linearizable import _on_tpu, _pallas_ok
         from jepsen_tpu.checker.events import n_words
 
+        if _on_tpu():
+            # Exact bitset batch first (one launch, one sync, definite
+            # verdicts — no per-key escalation): all keys must fit its
+            # envelope, sharing the max window/state buckets.
+            from jepsen_tpu.checker import wgl_bitset as bs
+            from jepsen_tpu.checker.models import model as get_model
+
+            bplan = bs.plan(
+                get_model(model),
+                window,
+                max(len(s.value_codes) for s in streams),
+            )
+            if bplan is not None:
+                bW, S = bplan
+                steps = [events_to_steps(s, W=bW) for s in streams]
+                outs = bs.check_keys_bitset(steps, model=model, S=S)
+                if not any(o[1] for o in outs):  # no taint ever
+                    res: List[dict] = []
+                    for o in outs:
+                        r = {
+                            "valid?": bool(o[0]),
+                            "method": "tpu-wgl-bitset-batch",
+                            "frontier_k": None,
+                            "escalations": 0,
+                        }
+                        if not o[0]:
+                            r["failed_op_index"] = int(o[2])
+                        res.append(r)
+                    return res
+
         if _on_tpu() and _pallas_ok(K, W, n_words(W)):
             # One batched megakernel launch: keys form the outer grid
             # dimension, one host sync for the whole batch.

@@ -1,0 +1,387 @@
+"""Exact bitset-automaton Pallas kernel for the WGL linearizability scan.
+
+The K-frontier kernels (wgl_jax.py, wgl_pallas.py) approximate the
+config set with a fixed-capacity table and pay for dedup / dominance
+pruning with [K, W, K] all-pairs compares every closure round. For the
+windows real register workloads produce (W <= 16 open ops), the ENTIRE
+config space is small enough to hold exactly:
+
+    config = (state row, linearized-slot mask)
+    space  = S rows x 2^W masks,   S = interned value codes + 1
+
+so the frontier becomes a [S, 2^W] BIT TENSOR, lane-packed 32 masks per
+int32 word ([S, 2^W/32] int32 in VMEM: W=16, S=8 -> 64 KB). This
+representation is exact — no capacity, no overflow, no escalation
+ladder, no dedup (set semantics are free: a config is a bit), and no
+dominance pruning (nothing ever needs to be evicted).
+
+A closure round linearizes each open window slot w against every config
+at once as three cheap whole-tensor ops:
+
+  1. source rows:  read/cas fire from one state row (a one-hot sublane
+     select); write fires from the union of all rows (a log-tree OR);
+  2. "add slot bit w" relabeling: masks without bit w map to masks with
+     it — for w < 5 an in-word masked shift by 2^w, for w >= 5 a masked
+     lane roll by 2^(w-5) words (pltpu.roll — mask bit w lives 2^(w-5)
+     words away at the same bit position);
+  3. destination scatter: OR into the dst state row (one-hot sublane
+     broadcast).
+
+Slots chain within a round (in-place monotone OR), so fixpoint arrives
+in <= W rounds; the usual case is 2 (one productive + one verification).
+The RETURN filter is the inverse relabeling with a *dynamic* slot
+index: keep masks containing the returning bit, shift them back
+(dynamic-shift roll), which also frees the slot for reuse.
+
+Soundness: every set bit is a config reached by a legal linearization
+chain that passed every prior RETURN filter (monotone ORs only add
+reachable configs; the round bound W+2 exceeds the longest possible
+chain, and non-convergence — impossible by that argument — still
+reports as taint rather than trusting the verdict). alive=False is
+therefore always definite: the empty frontier means NO linearization
+order exists, and the step's op_index is reported as the failing op.
+
+Reference role: the knossos search behind
+jepsen/src/jepsen/checker.clj:127-158, as an exact accelerator-resident
+automaton instead of a JVM graph search.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from jepsen_tpu.checker.events import ReturnSteps, bucket
+from jepsen_tpu.checker.models import model as get_model
+
+#: out columns: alive, taint, died op index, rounds total, rounds max
+OUT_COLS = 8
+
+#: per-step meta columns: slot, live, op_index, init_state
+META_COLS = 4
+
+#: return-steps per grid iteration (amortizes per-iteration block DMA)
+STEP_BLOCK = 8
+
+#: mask-word lane floor: smaller windows still use full vector lanes
+MIN_WORDS = 128
+
+#: supported window buckets (2^W/32 words: 128 and 2048 lanes)
+W_BUCKETS = (12, 16)
+
+#: state-row cap (VMEM: 32 x 2048 x 4 B = 256 KB at W=16)
+MAX_ROWS = 32
+
+_U = np.uint32
+#: in-word mask-bit patterns: _C1[k] has bit beta set iff beta & (1<<k)
+_C1 = tuple(
+    int(np.int32(_U(sum(1 << b for b in range(32) if b & (1 << k)))))
+    for k in range(5)
+)
+
+
+def w_bucket(window: int) -> int | None:
+    for w in W_BUCKETS:
+        if window <= w:
+            return w
+    return None
+
+
+def _rows_bucket(rows: int) -> int:
+    return max(8, bucket(rows, 8))
+
+
+def plan(m, window: int, n_value_codes: int) -> Tuple[int, int] | None:
+    """(W, S) kernel shape for a model + history envelope, or None when
+    the stream is outside the bitset kernel's envelope (window too wide,
+    too many state rows, or a model without slot transitions). The ONE
+    gate both the single-key driver and the key-batch path consult."""
+    if m.bitset_slot_jax is None:
+        return None
+    W = w_bucket(max(window, 1))
+    if W is None:
+        return None
+    S = _rows_bucket(m.bitset_rows(n_value_codes))
+    if S > MAX_ROWS:
+        return None
+    return W, S
+
+
+def _or_rows(fr, S: int):
+    """[S, M] -> [1, M] bitwise-OR over state rows (log tree)."""
+    x = fr
+    s = S
+    while s > 1:
+        h = s // 2
+        x = x[:h] | x[h : 2 * h]
+        s = h
+    return x
+
+
+def _add_bit(src, w: int, lane):
+    """Relabel masks m -> m | bit(w) for a static slot w: sources are
+    masks WITHOUT the bit; everything else contributes zero."""
+    if w < 5:
+        keep = jnp.int32(~np.int32(_C1[w]))
+        return (src & keep) << (1 << w)
+    sel = ((lane >> (w - 5)) & 1) == 0
+    return pltpu.roll(jnp.where(sel, src, 0), 1 << (w - 5), 1)
+
+
+def _remove_bit_dyn(fr, r, lane, M: int):
+    """Relabel masks m -> m & ~bit(r) keeping only masks WITH bit r, for
+    a dynamic returning slot r (the RETURN filter)."""
+    # In-word branch (r < 5): pattern constant selected by r, masked
+    # right-shift by 2^r.
+    c1 = jnp.int32(_C1[0])
+    for k in range(1, 5):
+        c1 = jnp.where(r == k, jnp.int32(_C1[k]), c1)
+    sh = jnp.left_shift(jnp.int32(1), jnp.minimum(r, 4))
+    # logical, not arithmetic: word bit 31 is a real mask bit, and an
+    # arithmetic >> would smear it across the word
+    intra = lax.shift_right_logical(fr & c1, sh)
+    # Word branch (r >= 5): lane roll back by 2^(r-5) words.
+    wb = jnp.maximum(r - 5, 0)
+    sel = ((lane >> wb) & 1) == 1
+    shift = jnp.int32(M) - jnp.left_shift(jnp.int32(1), wb)
+    word = pltpu.roll(jnp.where(sel, fr, 0), shift, 1)
+    return jnp.where(r < 5, intra, word)
+
+
+def _make_kernel(model_name: str, S: int, W: int):
+    bitset_slot = get_model(model_name).bitset_slot_jax
+    assert bitset_slot is not None, model_name
+    M = max((1 << W) // 32, MIN_WORDS)
+    B = STEP_BLOCK
+
+    def kernel(win_ref, meta_ref, out_ref, f_ref, snap_ref):
+        # Grid: (keys, step-blocks); steps iterate fastest, so the
+        # per-key frontier resets at each key's first block.
+        i = pl.program_id(1)
+
+        @pl.when(i == 0)
+        def _init():
+            init_state = meta_ref[0, 0, 3]
+            row = lax.broadcasted_iota(jnp.int32, (S, M), 0)
+            lane = lax.broadcasted_iota(jnp.int32, (S, M), 1)
+            # One config: the initial state row with the empty mask
+            # (mask 0 = word 0 bit 0).
+            f_ref[:] = jnp.where(
+                (row == init_state + 1) & (lane == 0), 1, 0
+            )
+            out_ref[0, 0, 0] = 1  # alive
+            out_ref[0, 0, 1] = 0  # taint (unconverged closure; never)
+            out_ref[0, 0, 2] = -1  # died op index
+            out_ref[0, 0, 3] = 0  # total closure rounds (debug)
+            out_ref[0, 0, 4] = 0  # max closure rounds in one step (debug)
+            out_ref[0, 0, 5] = 0
+            out_ref[0, 0, 6] = 0
+            out_ref[0, 0, 7] = 0
+
+        for b in range(B):
+            _substep(win_ref, meta_ref, out_ref, f_ref, snap_ref, b)
+
+    def _substep(win_ref, meta_ref, out_ref, f_ref, snap_ref, b):
+        slot_r = meta_ref[0, b, 0]
+        live = meta_ref[0, b, 1]
+        opidx = meta_ref[0, b, 2]
+        alive = out_ref[0, 0, 0]
+
+        @pl.when((alive == 1) & (live == 1))
+        def _step():
+            lane1 = lax.broadcasted_iota(jnp.int32, (1, M), 1)
+            rows = lax.broadcasted_iota(jnp.int32, (S, 1), 0)
+
+            # Rounds mutate the frontier ref in place so each slot's
+            # vector work sits under a pl.when on its SMEM occupancy
+            # scalar — a real branch, so unoccupied slots cost nothing
+            # (windows are mostly empty: W covers the worst step).
+            def round_fn(st):
+                _, r = st
+                snap_ref[:] = f_ref[:]
+                for w in range(W):
+                    occw = win_ref[0, b, 0, w]
+
+                    @pl.when(occw == 1)
+                    def _slot(w=w):
+                        fw = win_ref[0, b, 1, w]
+                        aw = win_ref[0, b, 2, w]
+                        bw = win_ref[0, b, 3, w]
+                        is_union, src_row, dst_row, valid = bitset_slot(
+                            fw, aw, bw
+                        )
+                        fr = f_ref[:]
+                        one_row = jnp.sum(
+                            jnp.where(rows == src_row, fr, 0),
+                            axis=0,
+                            keepdims=True,
+                        )
+                        union = _or_rows(fr, S)
+                        src = jnp.where(is_union, union, one_row)
+                        src = jnp.where(valid, src, 0)
+                        add = jnp.where(
+                            rows == dst_row, _add_bit(src, w, lane1), 0
+                        )
+                        f_ref[:] = fr | add
+
+                changed = jnp.any(f_ref[:] != snap_ref[:])
+                return changed, r + 1
+
+            def cond_fn(st):
+                changed, r = st
+                return changed & (r <= W + 2)
+
+            changed, nr = lax.while_loop(
+                cond_fn, round_fn, (jnp.bool_(True), jnp.int32(0))
+            )
+            out_ref[0, 0, 3] = out_ref[0, 0, 3] + nr
+            out_ref[0, 0, 4] = jnp.maximum(out_ref[0, 0, 4], nr)
+
+            # RETURN filter: keep configs with the returning op
+            # linearized, clear its bit (frees the slot).
+            fr = _remove_bit_dyn(f_ref[:], slot_r, lane1, M)
+            f_ref[:] = fr
+
+            @pl.when(changed)
+            def _taint():  # round bound hit (see module docstring)
+                out_ref[0, 0, 1] = 1
+
+            @pl.when(jnp.logical_not(jnp.any(fr != 0)))
+            def _died():
+                out_ref[0, 0, 0] = 0
+                out_ref[0, 0, 2] = opidx
+
+    return kernel, M
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model_name", "S", "W", "interpret")
+)
+def _bitset_scan(win, meta, model_name, S, W, interpret=False):
+    """Batched scan: win [n_keys, n, 4, W] int8 (occ/f/a/b — int8 on
+    the wire to quarter the host->device transfer, widened on device),
+    meta [n_keys, n, META_COLS] int32 -> out [n_keys, 1, OUT_COLS].
+    Keys form the outer grid dimension — one launch, one host sync per
+    batch."""
+    n_keys, n = win.shape[0], win.shape[1]
+    B = STEP_BLOCK
+    assert n % B == 0, f"steps {n} not a multiple of {B}"
+    kernel, M = _make_kernel(model_name, S, W)
+    win = win.astype(jnp.int32)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_keys, n // B),
+        in_specs=[
+            pl.BlockSpec(
+                (1, B, 4, W),
+                lambda k, i: (k, i, 0, 0),
+                memory_space=pltpu.SMEM,
+            ),
+            pl.BlockSpec(
+                (1, B, META_COLS),
+                lambda k, i: (k, i, 0),
+                memory_space=pltpu.SMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, OUT_COLS),
+            lambda k, i: (k, 0, 0),
+            memory_space=pltpu.SMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_keys, 1, OUT_COLS), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((S, M), jnp.int32),
+            pltpu.VMEM((S, M), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")
+        ),
+        interpret=interpret,
+    )(win, meta)
+
+
+def pack_steps(steps: ReturnSteps):
+    """Host-side packing: [n, 4, W] int8 window scalars (occ/f/a/b —
+    codes are < MAX_ROWS so int8 quarters the tunnel upload) + [n, 4]
+    int32 per-step meta, padded to a STEP_BLOCK multiple."""
+    B = STEP_BLOCK
+    if len(steps) % B or not len(steps):
+        steps = steps.padded(max(((len(steps) + B - 1) // B) * B, B))
+    n = len(steps)
+    meta = np.zeros((n, META_COLS), np.int32)
+    meta[:, 0] = steps.slot
+    meta[:, 1] = steps.live.astype(np.int32)
+    meta[:, 2] = steps.op_index
+    meta[:, 3] = steps.init_state
+    win = np.stack(
+        [steps.occ, steps.f, steps.a, steps.b], axis=1
+    ).astype(np.int8)
+    return win, meta
+
+
+def _out_to_verdicts(out: np.ndarray) -> List[Tuple[bool, bool, int]]:
+    return [
+        (bool(o[0]), bool(o[1]), int(o[2])) for o in out[:, 0, :]
+    ]
+
+
+def check_steps_bitset(
+    steps: ReturnSteps,
+    model: str = "cas-register",
+    S: int = 8,
+    interpret: bool = False,
+) -> Tuple[bool, bool, int]:
+    """Single-key exact check: (alive, taint, died_op_index). taint is
+    the overflow analog in the verdict contract and is always False in
+    practice (see module docstring)."""
+    args = getattr(steps, "_bitset_args", None)
+    if args is None:
+        win, meta = pack_steps(steps)
+        args = (jnp.asarray(win[None]), jnp.asarray(meta[None]))
+        steps._bitset_args = args
+    out = np.asarray(
+        _bitset_scan(
+            *args,
+            model_name=model if isinstance(model, str) else model.name,
+            S=S,
+            W=steps.W,
+            interpret=interpret,
+        )
+    )
+    return _out_to_verdicts(out)[0]
+
+
+def check_keys_bitset(
+    steps_list,
+    model: str = "cas-register",
+    S: int = 8,
+    interpret: bool = False,
+) -> List[Tuple[bool, bool, int]]:
+    """Batch of per-key exact checks in ONE kernel launch + host sync.
+    All steps must share W; lengths pad to a power-of-two bucket so one
+    compiled kernel serves every batch."""
+    n = bucket(max(max(len(st) for st in steps_list), 1), 64)
+    name = model if isinstance(model, str) else model.name
+    wins, metas = [], []
+    for st in steps_list:
+        w, m = pack_steps(st.padded(n))
+        wins.append(w)
+        metas.append(m)
+    out = np.asarray(
+        _bitset_scan(
+            jnp.asarray(np.stack(wins)),
+            jnp.asarray(np.stack(metas)),
+            model_name=name,
+            S=S,
+            W=steps_list[0].W,
+            interpret=interpret,
+        )
+    )
+    return _out_to_verdicts(out)

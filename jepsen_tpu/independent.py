@@ -256,13 +256,18 @@ class IndependentChecker:
         def key_dirname(k) -> str:
             # Percent-encode (no separators), uniquify colliding str()
             # forms (e.g. int 1 vs str "1"), and guard the dot names
-            # quote() leaves unescaped.
+            # quote() leaves unescaped. Uniquified names register in
+            # used_names too — quote() leaves '~' unescaped, so a
+            # literal key "1~1" must not collide with a generated one.
             name = urllib.parse.quote(str(k), safe="")
             if name in ("", ".", ".."):
                 name = f"k_{name.replace('.', '_')}"
-            n = used_names.get(name, 0)
-            used_names[name] = n + 1
-            return name if n == 0 else f"{name}~{n}"
+            while True:
+                n = used_names.get(name, 0)
+                used_names[name] = n + 1
+                if n == 0:
+                    return name
+                name = f"{name}~{n}"
         results = {}
         any_false = any_unknown = False
         for k, ops in sorted(

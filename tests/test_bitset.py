@@ -1,0 +1,191 @@
+"""Exact bitset WGL kernel parity tests (checker/wgl_bitset.py).
+
+Same contract as the other engines, but stricter: verdicts are always
+definite (taint must never fire), so every test asserts full agreement
+with the unbounded CPU oracle — on valid histories, corrupted ones, and
+crash-heavy ones. Runs in Pallas interpret mode on the CPU test mesh
+(tests/conftest.py); the TPU path is exercised by bench.py and the
+driver's entry() compile check.
+"""
+
+import random
+
+import pytest
+
+from jepsen_tpu.checker.events import events_to_steps, history_to_events
+from jepsen_tpu.checker.models import model as get_model
+from jepsen_tpu.checker.wgl_bitset import (
+    MAX_ROWS,
+    _rows_bucket,
+    check_keys_bitset,
+    check_steps_bitset,
+    w_bucket,
+)
+from jepsen_tpu.checker.wgl_jax import check_steps_jax
+from jepsen_tpu.checker.wgl_oracle import check_events
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.ops import info_op, invoke_op, ok_op
+from jepsen_tpu.sim import corrupt_history, gen_register_history
+
+
+def _plan(ev, model="cas-register"):
+    m = get_model(model)
+    W = w_bucket(max(ev.window, 1))
+    S = _rows_bucket(m.bitset_rows(len(ev.value_codes)))
+    assert W is not None and S <= MAX_ROWS
+    return W, S
+
+
+def _check(ev, model="cas-register"):
+    W, S = _plan(ev, model)
+    steps = events_to_steps(ev, W=W)
+    return check_steps_bitset(steps, model=model, S=S, interpret=True)
+
+
+def test_known_verdicts():
+    h = History([
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(0, "read"),
+        ok_op(0, "read", 1),
+    ])
+    alive, taint, died = _check(history_to_events(h))
+    assert alive is True and not taint and died == -1
+
+    h2 = History([
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(0, "read"),
+        ok_op(0, "read", None),  # stale read at history index 3
+    ])
+    alive, taint, died = _check(history_to_events(h2))
+    assert alive is False and not taint
+    assert died == 3
+
+
+def test_crashed_write_semantics():
+    h = History([
+        invoke_op(0, "write", 7),
+        info_op(0, "write", 7),
+        invoke_op(1, "read"),
+        ok_op(1, "read", 7),
+        invoke_op(1, "read"),
+        ok_op(1, "read", None),  # crashed write cannot unhappen
+    ])
+    alive, taint, _ = _check(history_to_events(h))
+    assert alive is False and not taint
+
+
+def test_empty_history():
+    alive, taint, died = _check(history_to_events(History([])))
+    assert alive is True and not taint and died == -1
+
+
+@pytest.mark.parametrize("p_crash", [0.0, 0.05, 0.15])
+def test_oracle_parity_random(p_crash):
+    """Differential sweep vs the unbounded oracle: the bitset verdict is
+    exact, so agreement must be total — valid and corrupted alike."""
+    for seed in range(25):
+        rng = random.Random(1000 + seed)
+        h = gen_register_history(
+            rng, n_ops=70, n_procs=4, p_crash=p_crash
+        )
+        if seed % 2:
+            h = corrupt_history(h, rng)
+        ev = history_to_events(h)
+        if w_bucket(max(ev.window, 1)) is None:
+            continue
+        alive, taint, died = _check(ev)
+        want = check_events(ev)
+        assert not taint, seed
+        assert alive == want, (seed, p_crash, alive, want)
+        if not alive:
+            assert died >= 0
+
+
+def test_died_index_parity_with_jax_kernel():
+    """On a definite-False verdict both exact engines must blame the
+    same completion (the first RETURN that empties the frontier)."""
+    for seed in range(12):
+        rng = random.Random(7000 + seed)
+        h = corrupt_history(
+            gen_register_history(rng, n_ops=60, n_procs=4, p_crash=0.03),
+            rng,
+        )
+        ev = history_to_events(h)
+        W, S = _plan(ev)
+        bsteps = events_to_steps(ev, W=W)
+        alive_b, taint, died_b = check_steps_bitset(
+            bsteps, S=S, interpret=True
+        )
+        jsteps = events_to_steps(ev, W=16)
+        alive_j, overflow, died_j = check_steps_jax(jsteps, K=512)
+        assert not taint and not overflow
+        assert alive_b == alive_j
+        if not alive_b:
+            assert died_b == died_j
+
+
+def test_mutex_model():
+    h = History([
+        invoke_op(0, "acquire"),
+        ok_op(0, "acquire"),
+        invoke_op(1, "acquire"),
+        invoke_op(0, "release"),
+        ok_op(0, "release"),
+        ok_op(1, "acquire"),
+    ])
+    ev = history_to_events(h, model="mutex")
+    alive, taint, _ = _check(ev, model="mutex")
+    assert alive is True and not taint
+
+    h2 = History([
+        invoke_op(0, "acquire"),
+        ok_op(0, "acquire"),
+        invoke_op(1, "acquire"),
+        ok_op(1, "acquire"),  # double acquire, no release
+    ])
+    ev2 = history_to_events(h2, model="mutex")
+    alive, taint, died = _check(ev2, model="mutex")
+    assert alive is False and not taint and died == 3
+
+
+def test_register_model_rejects_cas():
+    # Under the plain register model a cas op is outside the model and
+    # never linearizes, so an ok cas makes the history invalid.
+    h = History([
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(0, "cas", [1, 2]),
+        ok_op(0, "cas", [1, 2]),
+    ])
+    ev = history_to_events(h, model="register")
+    alive, taint, _ = _check(ev, model="register")
+    assert alive is False and not taint
+
+
+def test_batch_matches_single():
+    rng = random.Random(5)
+    streams = []
+    for seed in range(6):
+        r = random.Random(300 + seed)
+        h = gen_register_history(r, n_ops=50, n_procs=4, p_crash=0.04)
+        if seed % 3 == 0:
+            h = corrupt_history(h, r)
+        streams.append(history_to_events(h))
+    W = max(w_bucket(max(s.window, 1)) for s in streams)
+    m = get_model("cas-register")
+    S = _rows_bucket(
+        max(m.bitset_rows(len(s.value_codes)) for s in streams)
+    )
+    steps = [events_to_steps(s, W=W) for s in streams]
+    outs = check_keys_bitset(steps, S=S, interpret=True)
+    assert len(outs) == len(streams)
+    for s, (alive, taint, died) in zip(streams, outs):
+        assert not taint
+        assert alive == check_events(s)
+
+
+def test_wide_window_routes_out():
+    assert w_bucket(17) is None or w_bucket(17) >= 17
+    assert w_bucket(200) is None

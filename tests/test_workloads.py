@@ -418,3 +418,25 @@ def test_independent_artifact_names_safe_and_unique(tmp_path):
     assert len(dirs) == 3          # int 1 and str "1" did not collide
     # no separator survives, and no dirname IS a traversal component
     assert all("/" not in d and d not in (".", "..") for d in dirs)
+
+
+def test_independent_artifact_uniquifier_vs_literal_tilde(tmp_path):
+    """quote() leaves '~' unescaped, so a generated "1~1" uniquifier
+    must not collide with a literal key named "1~1"."""
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+
+    KV = independent.KV
+    h = History([
+        invoke_op(0, "write", KV(1, 1)), ok_op(0, "write", KV(1, 1)),
+        invoke_op(1, "write", KV("1", 2)), ok_op(1, "write", KV("1", 2)),
+        invoke_op(2, "write", KV("1~1", 3)),
+        ok_op(2, "write", KV("1~1", 3)),
+    ])
+    r = independent.independent_checker(LinearizableChecker()).check(
+        {"run_dir": str(tmp_path)}, h
+    )
+    assert r["key_count"] == 3
+    import os
+
+    dirs = sorted(os.listdir(tmp_path / "independent"))
+    assert len(dirs) == 3
