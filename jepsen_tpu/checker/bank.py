@@ -74,7 +74,23 @@ def _on_tpu() -> bool:
     return f()
 
 
+from dataclasses import dataclass, field
+
 from jepsen_tpu.checker.events import bucket as _bucket
+
+
+@dataclass
+class BankPlane:
+    """Columnar view of a bank history: the dense [rows, A] balance
+    matrix (NaN = nil/excluded) the device reduction consumes, plus the
+    record-view anchors needed for error artifacts. This is the
+    framework-native history form for the bank workload — encoded once
+    (BankChecker.encode), checked many times (the analyze seam)."""
+
+    bal: np.ndarray  # [n_rows >= R, A] float32; rows past R are padding
+    reads: List[Any]  # the R ok-read ops, in history order
+    #: reads excluded at encode time: (op, unexpected_keys)
+    unexpected: List[tuple] = field(default_factory=list)
 
 
 class BankChecker:
@@ -87,13 +103,16 @@ class BankChecker:
         self.negative_balances = negative_balances
         self.force_device = force_device
 
-    def check(self, test, history, opts=None) -> dict:
+    @staticmethod
+    def encode(test, history) -> BankPlane:
+        """One host pass interning balances into the dense matrix.
+        Object-keyed checks happen here; everything numeric is left to
+        the vectorized verdict in check()."""
         from jepsen_tpu.history.history import History
 
         if not isinstance(history, History):
             history = History(list(history))
         accounts = list(test.get("accounts", range(8)))
-        total = test.get("total_amount", 100)
         acct_idx = {a: i for i, a in enumerate(accounts)}
         A = len(accounts)
 
@@ -102,23 +121,8 @@ class BankChecker:
             and isinstance(o.value, dict)
         ]
         R = len(reads)
-        errors: Dict[str, dict] = {}
+        unexpected_rows: List[tuple] = []
 
-        def record(kind: str, op, **details):
-            e = errors.setdefault(
-                kind, {"count": 0, "first": None, "worst": None,
-                       "_badness": -1.0}
-            )
-            e["count"] += 1
-            entry = {"op_index": op.index, "value": op.value, **details}
-            if e["first"] is None:
-                e["first"] = entry
-            badness = details.get("badness", 0.0)
-            if badness > e["_badness"]:
-                e["_badness"] = badness
-                e["worst"] = entry
-
-        # Host pass: intern balances; object-keyed checks stay host-side.
         # Rows pad up to a power-of-two bucket (one compile per bucket).
         # Fast path: reads whose key tuple matches the account order
         # exactly (how clients build them) turn into one row tuple — no
@@ -138,10 +142,7 @@ class BankChecker:
             unexpected = [k for k in v if k not in acct_idx]
             if unexpected:
                 rows.append([_NAN] * A)  # excluded row
-                record(
-                    "unexpected-key", op,
-                    unexpected=unexpected, badness=float(len(unexpected)),
-                )
+                unexpected_rows.append((op, unexpected))
                 continue
             # Missing accounts count 0 toward the sum (surfacing as
             # wrong-total, as in the reference, which sums only the
@@ -156,6 +157,38 @@ class BankChecker:
         for i, op in slow:
             for k, x in op.value.items():
                 bal[i, acct_idx[k]] = _NAN if x is None else x
+        return BankPlane(bal=bal, reads=reads, unexpected=unexpected_rows)
+
+    def check(self, test, history, opts=None) -> dict:
+        total = test.get("total_amount", 100)
+        plane = (
+            history
+            if isinstance(history, BankPlane)
+            else self.encode(test, history)
+        )
+        bal, reads = plane.bal, plane.reads
+        R = len(reads)
+        errors: Dict[str, dict] = {}
+
+        def record(kind: str, op, **details):
+            e = errors.setdefault(
+                kind, {"count": 0, "first": None, "worst": None,
+                       "_badness": -1.0}
+            )
+            e["count"] += 1
+            entry = {"op_index": op.index, "value": op.value, **details}
+            if e["first"] is None:
+                e["first"] = entry
+            badness = details.get("badness", 0.0)
+            if badness > e["_badness"]:
+                e["_badness"] = badness
+                e["worst"] = entry
+
+        for op, unexpected in plane.unexpected:
+            record(
+                "unexpected-key", op,
+                unexpected=unexpected, badness=float(len(unexpected)),
+            )
 
         if R:
             has_nil, wrong_total, negative, sums = _bank_reduce(

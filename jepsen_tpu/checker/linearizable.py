@@ -127,11 +127,10 @@ def check_events_bucketed(
     # fires, fall through to the capacity-ladder paths below.
     plan = _bitset_plan(events, m) if _on_tpu() else None
     if plan is not None:
-        from jepsen_tpu.checker.events import events_to_steps as _ets
         from jepsen_tpu.checker.wgl_bitset import check_steps_bitset
 
         bW, S = plan
-        bsteps = _ets(events, W=bW)
+        bsteps = events_to_steps(events, W=bW)
         bsteps = bsteps.padded(_bucket_events(max(len(bsteps), 1)))
         alive, taint, died = check_steps_bitset(bsteps, model=model, S=S)
         if not taint:

@@ -340,7 +340,12 @@ def check_steps_bitset(
 ) -> Tuple[bool, bool, int]:
     """Single-key exact check: (alive, taint, died_op_index). taint is
     the overflow analog in the verdict contract and is always False in
-    practice (see module docstring)."""
+    practice (see module docstring).
+
+    The packed device args memoize on the steps object (same discipline
+    as wgl_pallas: ReturnSteps are treated as immutable once checked —
+    every driver path builds them fresh via events_to_steps; mutating
+    one in place after a check would replay stale device data)."""
     args = getattr(steps, "_bitset_args", None)
     if args is None:
         win, meta = pack_steps(steps)
