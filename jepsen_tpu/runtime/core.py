@@ -460,7 +460,14 @@ def run(test: Dict[str, Any]) -> Dict[str, Any]:
 
     threads = list(range(n)) + [NEMESIS]
     t0 = _time.monotonic_ns()
-    sched = Scheduler(test.get("generator"), test, threads, t0)
+    # A workload's final phase (queue drain, final set/monotonic read)
+    # composes AFTER the main generator — so a time_limit applied to
+    # "generator" can never truncate it (the reference's
+    # :final-generator convention, e.g. hazelcast.clj:309-317).
+    generator = test.get("generator")
+    if test.get("final_generator") is not None:
+        generator = gen.phases(generator, test["final_generator"])
+    sched = Scheduler(generator, test, threads, t0)
     rec = _HistoryRecorder()
 
     # Environment lifecycle (core.clj:538-552): OS setup on every node,

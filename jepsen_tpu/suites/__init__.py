@@ -7,6 +7,19 @@ shared CLI, following the canonical 197-line etcd shape
 (etcd/src/jepsen/etcd.clj:149-188).
 """
 
-from jepsen_tpu.suites import consul, etcd, tidb, zookeeper
+from jepsen_tpu.suites import (
+    cockroachdb,
+    consul,
+    etcd,
+    galera,
+    hazelcast,
+    mongodb,
+    rabbitmq,
+    tidb,
+    zookeeper,
+)
 
-__all__ = ["consul", "etcd", "tidb", "zookeeper"]
+__all__ = [
+    "cockroachdb", "consul", "etcd", "galera", "hazelcast", "mongodb",
+    "rabbitmq", "tidb", "zookeeper",
+]

@@ -1,0 +1,208 @@
+"""Galera (MariaDB) suite: bank over synchronous replication.
+
+Reference: galera/src/jepsen/galera.clj (529 LoC with dirty_reads) —
+mariadb-galera apt install with debconf-seeded root password
+(:35-60), a wsrep cluster-address bootstrap (first node
+gcomm://, the rest join), and the bank workload over SQL
+transactions; the companion dirty-reads workload reads mid-transaction
+state.
+
+Real mode drives mysqld through the mysql CLI on the nodes; dummy mode
+uses the in-memory bank client. Checker: the columnar bank reduction.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, Optional
+
+from jepsen_tpu import net as netlib, nemesis as nemlib
+from jepsen_tpu.control.core import sessions_for
+from jepsen_tpu.db import DB
+from jepsen_tpu.generator import pure as gen
+from jepsen_tpu.history.ops import Op
+from jepsen_tpu.os import Debian
+from jepsen_tpu.runtime.client import Client, ClientFailed
+
+PASSWORD = "jepsen"
+
+
+class GaleraDB(DB):
+    """mariadb-galera install + wsrep bootstrap (galera.clj:35-90)."""
+
+    def setup(self, test, node, session):
+        for line in (
+            f"mariadb-galera-server-10.0 mysql-server/root_password "
+            f"password {PASSWORD}",
+            f"mariadb-galera-server-10.0 mysql-server/root_password_again "
+            f"password {PASSWORD}",
+        ):
+            session.exec(
+                "sh", "-c", f"echo '{line}' | debconf-set-selections",
+                sudo=True,
+            )
+        session.exec(
+            "apt-get", "install", "-y", "mariadb-galera-server",
+            sudo=True,
+        )
+        primary = test["nodes"][0]
+        peers = "" if node == primary else ",".join(test["nodes"])
+        conf = (
+            "[mysqld]\\n"
+            "wsrep_on=ON\\n"
+            "wsrep_provider=/usr/lib/galera/libgalera_smm.so\\n"
+            f"wsrep_cluster_address=gcomm://{peers}\\n"
+            "binlog_format=ROW\\n"
+        )
+        session.exec(
+            "sh", "-c",
+            f"printf '{conf}' > /etc/mysql/conf.d/galera.cnf",
+            sudo=True,
+        )
+        if node == primary:
+            session.exec(
+                "service", "mysql", "restart", "--wsrep-new-cluster",
+                sudo=True,
+            )
+        else:
+            session.exec("service", "mysql", "restart", sudo=True)
+
+    def teardown(self, test, node, session):
+        session.exec("service", "mysql", "stop", sudo=True, check=False)
+
+    def log_files(self, test, node):
+        return ["/var/log/mysql.err", "/var/log/mysql.log"]
+
+
+class GaleraBankClient(Client):
+    """Bank over the mysql CLI (galera.clj's bank client role)."""
+
+    def __init__(self, node=None, accounts=range(8), total: int = 100):
+        self.node = node
+        self.accounts = list(accounts)
+        self.total = total
+
+    def open(self, test, node):
+        return GaleraBankClient(node, self.accounts, self.total)
+
+    def _sql(self, test, stmt: str) -> str:
+        sess = sessions_for(test)[self.node]
+        return sess.exec(
+            "mysql", "-h", self.node, "-u", "root",
+            f"-p{PASSWORD}", "--batch", "--raw", "-e", stmt, "jepsen",
+        )
+
+    def setup(self, test):
+        per = self.total // len(self.accounts)
+        rows = ",".join(f"({a},{per})" for a in self.accounts)
+        try:
+            self._sql(
+                test,
+                "CREATE TABLE IF NOT EXISTS accounts "
+                "(id INT PRIMARY KEY, balance BIGINT); "
+                f"INSERT IGNORE INTO accounts VALUES {rows};",
+            )
+        except Exception:
+            pass  # another worker's setup won the race
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                out = self._sql(
+                    test, "SELECT id, balance FROM accounts;"
+                )
+                balances = {}
+                for line in out.splitlines()[1:]:
+                    parts = line.split("\t")
+                    if len(parts) == 2:
+                        balances[int(parts[0])] = int(parts[1])
+                return op.with_(type="ok", value=balances)
+            if op.f == "transfer":
+                v = op.value
+                amt, frm, to = (
+                    int(v["amount"]), int(v["from"]), int(v["to"])
+                )
+                self._sql(
+                    test,
+                    "BEGIN; "
+                    f"UPDATE accounts SET balance = balance - {amt} "
+                    f"WHERE id = {frm} AND balance >= {amt}; "
+                    f"UPDATE accounts SET balance = balance + {amt} "
+                    f"WHERE id = {to} AND ROW_COUNT() > 0; COMMIT;",
+                )
+                return op.with_(type="ok")
+            raise ValueError(f"unknown op f={op.f!r}")
+        except ValueError:
+            raise
+        except Exception as e:
+            if op.f == "read":
+                raise ClientFailed(str(e))
+            raise
+
+
+def galera_test(opts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    opts = dict(opts or {})
+    rng = opts.pop("rng", None) or random.Random(opts.pop("seed", 0))
+    dummy = opts.pop("dummy", False)
+    n_ops = opts.pop("ops", 400)
+    time_limit_s = opts.pop("time_limit", None)
+
+    from jepsen_tpu.workloads import bank
+
+    spec = bank.workload(n_ops=n_ops, rng=rng)
+    # workload generators arrive thread-scoped already — no rewrap
+    generator = spec["generator"]
+    if time_limit_s:
+        generator = gen.time_limit(time_limit_s, generator)
+    test: Dict[str, Any] = {
+        "name": "galera",
+        "os": Debian(),
+        "db": GaleraDB(),
+        "client": GaleraBankClient(),
+        "net": netlib.IptablesNet(),
+        "nemesis": nemlib.partition_random_halves(rng=rng),
+        "generator": generator,
+        "checker": spec["checker"],
+        "accounts": spec.get("accounts", list(range(8))),
+        "total_amount": spec.get("total_amount", 100),
+    }
+    if dummy:
+        test.pop("os")
+        test.pop("db")
+        test["client"] = spec["client"]
+        test["net"] = netlib.MemNet()
+    test.update(opts)
+    return test
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from jepsen_tpu.runtime import run
+
+    p = argparse.ArgumentParser(prog="jepsen_tpu.suites.galera")
+    p.add_argument("--nodes", default="n1,n2,n3,n4,n5")
+    p.add_argument("--ops", type=int, default=400)
+    p.add_argument("--time-limit", type=float, default=30.0)
+    p.add_argument("--concurrency", type=int, default=5)
+    p.add_argument("--dummy", action="store_true")
+    p.add_argument("--store", default="store")
+    args = p.parse_args(argv)
+    test = galera_test({
+        "dummy": args.dummy,
+        "ops": args.ops,
+        "nodes": [n for n in args.nodes.split(",") if n],
+        "time_limit": args.time_limit,
+    })
+    test["concurrency"] = args.concurrency
+    test["store"] = args.store
+    test = run(test)
+    valid = test["results"].get("valid?")
+    print(f"valid?={valid}")
+    return 0 if valid is True else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -76,9 +76,9 @@ def workload(
     )
     return {
         "client": SetClient(lossy=lossy, rng=rng),
-        "generator": gen.clients([
-            gen.limit(n_adds, mix),
-            gen.once(reads()),  # final read so every element is judged
-        ]),
+        "generator": gen.clients(gen.limit(n_adds, mix)),
+        # final read so every element is judged — runs after the main
+        # phase, outside any time limit (runtime final_generator slot)
+        "final_generator": gen.clients(gen.once(reads())),
         "checker": SetFullChecker() if full else set_checker(),
     }

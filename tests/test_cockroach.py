@@ -1,0 +1,256 @@
+"""CockroachDB suite tests: the nemesis-catalog composition
+machinery (named specs, pairwise compose, slowing/restarting/skew
+wrappers), the monotonic workload + checker, and dummy-mode end-to-end
+runs per workload."""
+
+import random
+
+import pytest
+
+from jepsen_tpu.control import DummyRemote
+from jepsen_tpu.control.core import sessions_for
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.ops import (
+    fail_op,
+    info_op,
+    invoke_op,
+    ok_op,
+)
+from jepsen_tpu.runtime import run
+from jepsen_tpu.suites import cockroachdb as cr
+
+
+# -- monotonic checker ------------------------------------------------------
+
+
+def _mono_history(rows, adds=None):
+    ops = []
+    for i, v in enumerate(adds or [r[0] for r in rows]):
+        ops.append(invoke_op(i % 3, "add"))
+        ops.append(ok_op(i % 3, "add", {"val": v, "sts": 0}))
+    ops.append(invoke_op(0, "read"))
+    ops.append(ok_op(0, "read", [
+        {"val": v, "sts": s, "proc": p} for v, s, p in rows
+    ]))
+    return History(ops)
+
+
+def test_monotonic_checker_valid():
+    from jepsen_tpu.checker.monotonic import MonotonicChecker
+
+    h = _mono_history([(1, 10, 0), (2, 20, 1), (3, 30, 0)])
+    r = MonotonicChecker().check({}, h)
+    assert r["valid?"] is True, r
+
+
+def test_monotonic_checker_catches_order_and_loss():
+    from jepsen_tpu.checker.monotonic import MonotonicChecker
+
+    # value order disagrees with sts order
+    h = _mono_history([(2, 10, 0), (1, 20, 1)], adds=[1, 2])
+    r = MonotonicChecker().check({}, h)
+    assert r["valid?"] is False
+    assert r["off_order_vals"] == [[2, 1]]
+
+    # lost: acked add 3 never read
+    h2 = _mono_history([(1, 10, 0), (2, 20, 1)], adds=[1, 2, 3])
+    r2 = MonotonicChecker().check({}, h2)
+    assert r2["valid?"] is False and r2["lost"] == [3]
+
+    # revived: failed add appears in the read
+    ops = [
+        invoke_op(0, "add"), ok_op(0, "add", {"val": 1, "sts": 10}),
+        invoke_op(1, "add"), fail_op(1, "add", {"val": 2, "sts": 0}),
+        invoke_op(2, "add"), info_op(2, "add", {"val": 3, "sts": 0}),
+        invoke_op(0, "read"),
+        ok_op(0, "read", [
+            {"val": 1, "sts": 10, "proc": 0},
+            {"val": 2, "sts": 20, "proc": 1},
+            {"val": 3, "sts": 30, "proc": 2},
+        ]),
+    ]
+    r3 = MonotonicChecker().check({}, History(ops))
+    assert r3["valid?"] is False
+    assert r3["revived"] == [2] and r3["recovered"] == [3]
+
+
+def test_monotonic_checker_unknown_without_read():
+    from jepsen_tpu.checker.monotonic import MonotonicChecker
+
+    ops = [invoke_op(0, "add"), ok_op(0, "add", {"val": 1, "sts": 1})]
+    r = MonotonicChecker().check({}, History(ops))
+    assert r["valid?"] == "unknown"
+
+
+def test_monotonic_workload_dummy_run_valid():
+    test = cr.cockroach_test({
+        "dummy": True, "workload": "monotonic", "ops": 60,
+        "nodes": ["n1", "n2", "n3"], "rng": random.Random(3),
+    })
+    test["concurrency"] = 4
+    out = run(test)
+    assert out["results"]["valid?"] is True, out["results"]
+
+
+def test_monotonic_workload_skewed_caught():
+    from jepsen_tpu.workloads import monotonic as mono
+
+    spec = mono.workload(n_ops=120, skewed=True, rng=random.Random(5))
+    out = run({**spec, "name": "mono-skew", "concurrency": 4})
+    r = out["results"]
+    assert r["valid?"] is False
+    assert r["off_order_vals"], r  # timestamp order lied about commit order
+
+
+# -- nemesis catalog --------------------------------------------------------
+
+
+def test_compose_specs_routes_and_merges():
+    rng = random.Random(0)
+    spec = cr.compose_specs(
+        [cr.parts_spec(rng), cr.startstop_spec(1, rng)], rng=rng
+    )
+    assert spec["name"] == "parts+startstop"
+    assert spec["clocks"] is False
+    # the composed client routes "parts:start" to the partitioner
+    from jepsen_tpu import nemesis as nemlib
+
+    assert isinstance(spec["client"], nemlib.Compose)
+
+
+def test_compose_specs_rejects_duplicate_names():
+    with pytest.raises(AssertionError):
+        cr.compose_specs([cr.parts_spec(), cr.parts_spec()])
+
+
+def test_skew_catalog_grades():
+    names = {
+        n: cr.NEMESES[n]()
+        for n in (
+            "small-skews", "subcritical-skews", "critical-skews",
+            "big-skews", "huge-skews", "strobe-skews",
+        )
+    }
+    for n, s in names.items():
+        assert s["clocks"] is True, n
+    # big/huge wrap the restarting bump in a slowing net wrapper
+    assert isinstance(names["big-skews"]["client"], cr.Slowing)
+    assert isinstance(names["small-skews"]["client"], cr.Restarting)
+
+
+def test_bump_time_nemesis_commands():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2"], "remote": remote}
+    nem = cr.BumpTime(0.25, rng=random.Random(1))
+    nem.setup(test)
+    out = nem.invoke(test, info_op("nemesis", "start").with_(
+        type="invoke"
+    ))
+    assert out.type == "info"
+    cmds = remote.commands("n1") + remote.commands("n2")
+    assert any("bump_time" in c and "250" in c for c in cmds) or \
+        out.value == {}, cmds
+    out2 = nem.invoke(test, info_op("nemesis", "stop").with_(
+        type="invoke"
+    ))
+    assert out2.type == "info"
+    assert any("date" in c for c in remote.commands("n1"))
+
+
+def test_split_nemesis_dummy_and_keyrange():
+    nem = cr.SplitNemesis()
+    test = {"dummy": True, "nodes": ["n1"], "keyrange": {3, 7}}
+    op = invoke_op("nemesis", "split")
+    out = nem.invoke(test, op)
+    assert out.value == ["split", 7]
+    out2 = nem.invoke(test, op)
+    assert out2.value == ["split", 3]
+    out3 = nem.invoke(test, op)
+    assert out3.value == "nothing-to-split"
+
+
+def test_restarting_wrapper_restarts_on_stop():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2"], "remote": remote}
+
+    from jepsen_tpu import nemesis as nemlib
+
+    inner = nemlib.Noop()
+    nem = cr.Restarting(inner)
+    out = nem.invoke(test, invoke_op("nemesis", "stop"))
+    assert out.value[1] == {"n1": "started", "n2": "started"}
+    cmds = remote.commands("n1")
+    assert any("cockroach start" in c for c in cmds)
+
+
+# -- suite end-to-end (dummy) -----------------------------------------------
+
+
+@pytest.mark.parametrize("workload", ["register", "bank", "sets", "g2"])
+def test_cockroach_dummy_workloads(workload):
+    test = cr.cockroach_test({
+        "dummy": True,
+        "workload": workload,
+        "ops": 60,
+        "keys": 3 if workload in ("register", "g2") else 3,
+        "per_key_ops": 12,
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "rng": random.Random(11),
+    })
+    test["concurrency"] = 6
+    out = run(test)
+    assert out["results"]["valid?"] is True, out["results"]
+
+
+def test_cockroach_dummy_with_composed_nemesis():
+    test = cr.cockroach_test({
+        "dummy": True,
+        "workload": "register",
+        "keys": 2,
+        "per_key_ops": 10,
+        "nemesis": [cr.split_spec(delay_s=0.2)],
+        "time_limit": 2.0,
+        "nodes": ["n1", "n2", "n3"],
+        "rng": random.Random(13),
+    })
+    test["concurrency"] = 4
+    out = run(test)
+    assert out["results"]["valid?"] is True, out["results"]
+    nem_ops = [o for o in out["history"].ops
+               if o.process == "nemesis" and o.type == "info"]
+    assert any(o.f == "splits:split" for o in nem_ops)
+
+
+def test_cockroach_db_commands():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2", "n3"], "remote": remote}
+    db = cr.CockroachDB()
+    sess = sessions_for(test)
+    db.setup(test, "n1", sess["n1"])
+    cmds = remote.commands("n1")
+    assert any("wget" in c and "cockroach" in c for c in cmds)
+    assert any("--join=n1:26257,n2:26257,n3:26257" in c for c in cmds)
+    assert any("cockroach init" in c.replace(cr.BINARY, "cockroach")
+               for c in cmds)
+    db.teardown(test, "n1", sess["n1"])
+
+
+def test_sql_register_client_command_shapes():
+    remote = DummyRemote()
+    test = {"nodes": ["n1"], "remote": remote}
+    from jepsen_tpu import independent
+
+    c = cr.SqlRegisterClient().open(test, "n1")
+    c.setup(test)
+    op = invoke_op(0, "write", independent.KV(4, 2))
+    out = c.invoke(test, op)
+    assert out.type == "ok"
+    assert 4 in test["keyrange"]
+    cmds = remote.commands("n1")
+    assert any("UPSERT INTO kv VALUES (4, 2)" in c2 for c2 in cmds)
+    # dummy remote returns empty stdout -> read sees no rows -> None
+    out = c.invoke(test, invoke_op(0, "read", independent.KV(4, None)))
+    assert out.type == "ok" and out.value.value is None
+    # cas with no returned row -> fail
+    out = c.invoke(test, invoke_op(0, "cas", independent.KV(4, [0, 1])))
+    assert out.type == "fail"

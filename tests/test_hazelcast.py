@@ -1,0 +1,66 @@
+"""Hazelcast suite tests: every coordination-primitive workload runs
+end-to-end in dummy mode, each checker catches its client's weak-mode
+anomaly, and the DB automation emits the right commands."""
+
+import random
+
+import pytest
+
+from jepsen_tpu.control import DummyRemote
+from jepsen_tpu.control.core import sessions_for
+from jepsen_tpu.runtime import run
+from jepsen_tpu.suites import hazelcast as hz
+
+
+def _run(workload, weak=False, ops=150, seed=1):
+    test = hz.hazelcast_test({
+        "dummy": True,
+        "workload": workload,
+        "weak": weak,
+        "ops": ops,
+        "nodes": ["n1", "n2", "n3"],
+        "rng": random.Random(seed),
+    })
+    test["concurrency"] = 4
+    return run(test)["results"]
+
+
+@pytest.mark.parametrize(
+    "workload", ["lock", "queue", "id-gen", "cas", "long-fork"]
+)
+def test_workloads_valid(workload):
+    r = _run(workload)
+    assert r["valid?"] is True, r
+
+
+def test_weak_lock_caught():
+    # The split-brain double-acquire violates the mutex model.
+    r = _run("lock", weak=True, ops=400, seed=3)
+    assert r["valid?"] is False, r
+
+
+def test_weak_queue_caught():
+    # Dropped acked enqueues violate queue conservation.
+    r = _run("queue", weak=True, ops=500, seed=4)
+    assert r["valid?"] is False, r
+    assert r["lost-count"] > 0, r
+
+
+def test_weak_id_gen_caught():
+    r = _run("id-gen", weak=True, ops=600, seed=5)
+    assert r["valid?"] is False, r
+    assert r["duplicated-count"] > 0, r
+
+
+def test_db_commands():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2", "n3"], "remote": remote}
+    db = hz.HazelcastDB()
+    sess = sessions_for(test)
+    db.setup(test, "n1", sess["n1"])
+    cmds = remote.commands("n1")
+    assert any("wget" in c and "hazelcast" in c for c in cmds)
+    assert any(
+        "java" in c and "--members n2,n3" in c for c in cmds
+    ), cmds
+    db.teardown(test, "n1", sess["n1"])

@@ -1,0 +1,95 @@
+"""Simple single-file suites (rabbitmq / mongodb / galera): dummy-mode
+end-to-end runs and real-mode command shapes against the recording
+dummy control plane."""
+
+import random
+
+from jepsen_tpu.control import DummyRemote
+from jepsen_tpu.control.core import sessions_for
+from jepsen_tpu.history.ops import invoke_op
+from jepsen_tpu.runtime import run
+from jepsen_tpu.suites import galera, mongodb, rabbitmq
+
+
+def test_rabbitmq_dummy_end_to_end():
+    test = rabbitmq.rabbitmq_test({
+        "dummy": True, "ops": 120,
+        "nodes": ["n1", "n2", "n3"], "rng": random.Random(2),
+    })
+    test["concurrency"] = 4
+    out = run(test)
+    assert out["results"]["valid?"] is True, out["results"]
+
+
+def test_rabbitmq_db_commands():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2"], "remote": remote, "barrier": None}
+    db = rabbitmq.RabbitDB()
+    sess = sessions_for(test)
+    db.setup(test, "n1", sess["n1"])
+    cmds = remote.commands("n1")
+    assert any("wget" in c and "rabbitmq-server" in c for c in cmds)
+    assert any("erlang.cookie" in c for c in cmds)
+    assert any("set_policy" in c for c in cmds)
+    # the second node joins the first
+    db.setup(test, "n2", sess["n2"])
+    cmds2 = remote.commands("n2")
+    assert any("join_cluster rabbit@n1" in c for c in cmds2)
+
+
+def test_mongodb_dummy_end_to_end():
+    test = mongodb.mongodb_test({
+        "dummy": True, "ops": 150,
+        "nodes": ["n1", "n2", "n3"], "rng": random.Random(4),
+    })
+    test["concurrency"] = 4
+    out = run(test)
+    r = out["results"]
+    assert r["valid?"] is True, r
+    assert r["method"].startswith(("tpu-wgl", "cpu-oracle"))
+
+
+def test_mongodb_db_and_client_commands():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2"], "remote": remote, "barrier": None}
+    db = mongodb.MongoDB()
+    sess = sessions_for(test)
+    db.setup(test, "n1", sess["n1"])
+    cmds = remote.commands("n1")
+    assert any("mongod" in c and "--replSet jepsen" in c for c in cmds)
+    assert any("rs.initiate" in c for c in cmds)
+
+    c = mongodb.DocumentCasClient().open(test, "n1")
+    out = c.invoke(test, invoke_op(0, "read"))
+    assert out.type == "ok" and out.value is None  # empty shell output
+    out = c.invoke(test, invoke_op(0, "write", 3))
+    assert out.type == "ok"
+    out = c.invoke(test, invoke_op(0, "cas", [3, 4]))
+    assert out.type == "fail"  # dummy stdout != "hit"
+    cmds = remote.commands("n1")
+    assert any("findAndModify" in c2 for c2 in cmds)
+
+
+def test_galera_dummy_end_to_end():
+    test = galera.galera_test({
+        "dummy": True, "ops": 200,
+        "nodes": ["n1", "n2", "n3"], "rng": random.Random(6),
+    })
+    test["concurrency"] = 4
+    out = run(test)
+    assert out["results"]["valid?"] is True, out["results"]
+
+
+def test_galera_db_commands():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2"], "remote": remote}
+    db = galera.GaleraDB()
+    sess = sessions_for(test)
+    db.setup(test, "n1", sess["n1"])
+    cmds = remote.commands("n1")
+    assert any("debconf-set-selections" in c for c in cmds)
+    assert any("wsrep-new-cluster" in c for c in cmds)  # bootstrap node
+    db.setup(test, "n2", sess["n2"])
+    cmds2 = remote.commands("n2")
+    assert any("gcomm://n1,n2" in c for c in cmds2)
+    assert not any("wsrep-new-cluster" in c for c in cmds2)
