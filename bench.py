@@ -77,7 +77,7 @@ def bench_config1():
 
     check_keys(streams)  # warmup/compile
     check_events_bucketed(streams[1])  # warmup the single-check shape
-    tpu_wall, results = _time(lambda: check_keys(streams))
+    tpu_wall, results = _time(lambda: check_keys(streams), reps=3)
     single_wall, r1 = _time(
         lambda: check_events_bucketed(streams[1]), reps=3
     )
@@ -115,7 +115,7 @@ def bench_config2():
         streams.append(history_to_events(h))
     n_ops = sum(s.n_ops for s in streams)
     check_keys(streams)  # warmup/compile
-    tpu_wall, results = _time(lambda: check_keys(streams))
+    tpu_wall, results = _time(lambda: check_keys(streams), reps=3)
     t0 = time.perf_counter()
     wants = [oracle(s) for s in streams]
     oracle_wall = time.perf_counter() - t0
@@ -301,7 +301,7 @@ def bench_north_star():
     )
     ev = history_to_events(h)
     r = check_events_bucketed(ev)  # warmup/compile
-    tpu_wall, r = _time(lambda: check_events_bucketed(ev))
+    tpu_wall, r = _time(lambda: check_events_bucketed(ev), reps=3)
     assert tpu_wall < 60, f"north-star budget blown: {tpu_wall:.1f}s"
     assert r["valid?"] is True, r
     # Oracle on a half-history prefix, extrapolated x2. This UNDERSTATES

@@ -150,6 +150,13 @@ class ReturnSteps:
     op_index: np.ndarray
     init_state: int
     W: int
+    #: [n, n_words(W)] int32 — mask of slots whose occupant was invoked
+    #: since the PREVIOUS return. The frontier stays closed under
+    #: already-open ops across a RETURN filter (the filter map commutes
+    #: with expansion), so a step's closure only has new work for these
+    #: slots — the bitset kernel's first closure round expands just
+    #: them and can stop immediately if nothing was added.
+    fresh: Optional[np.ndarray] = None
 
     def __len__(self) -> int:
         return int(self.slot.shape[0])
@@ -181,6 +188,13 @@ class ReturnSteps:
             ),
             init_state=self.init_state,
             W=self.W,
+            fresh=(
+                np.concatenate(
+                    [self.fresh, np.zeros((pad, nw), np.int32)]
+                )
+                if self.fresh is not None
+                else None
+            ),
         )
 
 
@@ -269,6 +283,17 @@ def events_to_steps(events: EventStream, W: int) -> ReturnSteps:
         out_opidx = events.op_index[ret_pos].astype(np.int32)
     else:
         out_opidx = np.full(n_ret, -1, np.int32)
+
+    # Newly invoked slots per step: each INVOKE lands in the step of
+    # the first return after it (invokes past the last return never
+    # face a filter and are irrelevant to the verdict).
+    inv_pos = np.nonzero(is_inv)[0]
+    step_of = np.searchsorted(ret_pos, inv_pos, side="left")
+    keep = step_of < n_ret
+    out_fresh = np.zeros((n_ret, nw), np.int32)
+    if keep.any():
+        inv_bits = bits[slot[inv_pos[keep]]]  # [k, nw]
+        np.bitwise_or.at(out_fresh, step_of[keep], inv_bits)
     return ReturnSteps(
         occ=out_occ,
         f=out_f,
@@ -280,6 +305,7 @@ def events_to_steps(events: EventStream, W: int) -> ReturnSteps:
         op_index=out_opidx,
         init_state=events.init_state,
         W=W,
+        fresh=out_fresh,
     )
 
 
@@ -304,9 +330,11 @@ def events_to_steps_loop(events: EventStream, W: int) -> ReturnSteps:
     out_slot = np.zeros(n_ret, np.int32)
     out_crash = np.zeros((n_ret, nw), np.int32)
     out_opidx = np.full(n_ret, -1, np.int32)
+    out_fresh = np.zeros((n_ret, nw), np.int32)
     has_opidx = events.op_index is not None
     bits = slot_bit_table(W)
     j = 0
+    fresh = np.zeros(nw, np.int32)
     for i in range(len(events)):
         kind = int(events.kind[i])
         s = int(events.slot[i])
@@ -315,6 +343,7 @@ def events_to_steps_loop(events: EventStream, W: int) -> ReturnSteps:
             sf[s] = events.f[i]
             sa[s] = events.a[i]
             sb[s] = events.b[i]
+            fresh |= bits[s]
             if crashed_inv[i]:
                 crash |= bits[s]
         elif kind == EV_RETURN:
@@ -324,6 +353,8 @@ def events_to_steps_loop(events: EventStream, W: int) -> ReturnSteps:
             out_b[j] = sb
             out_slot[j] = s
             out_crash[j] = crash
+            out_fresh[j] = fresh
+            fresh = np.zeros(nw, np.int32)
             if has_opidx:
                 out_opidx[j] = events.op_index[i]
             j += 1
@@ -339,6 +370,7 @@ def events_to_steps_loop(events: EventStream, W: int) -> ReturnSteps:
         op_index=out_opidx,
         init_state=events.init_state,
         W=W,
+        fresh=out_fresh,
     )
 
 

@@ -127,12 +127,18 @@ def check_events_bucketed(
     # fires, fall through to the capacity-ladder paths below.
     plan = _bitset_plan(events, m) if _on_tpu() else None
     if plan is not None:
-        from jepsen_tpu.checker.wgl_bitset import check_steps_bitset
+        from jepsen_tpu.checker.wgl_bitset import (
+            check_steps_bitset_segmented,
+        )
 
         bW, S = plan
         bsteps = events_to_steps(events, W=bW)
-        bsteps = bsteps.padded(_bucket_events(max(len(bsteps), 1)))
-        alive, taint, died = check_steps_bitset(bsteps, model=model, S=S)
+        # Segment-aware: the prefix before crashes widen the window
+        # runs on the narrow (16x cheaper) kernel; padding/bucketing
+        # happens per segment inside.
+        alive, taint, died = check_steps_bitset_segmented(
+            bsteps, model=model, S=S
+        )
         if not taint:
             out = {
                 "valid?": alive,

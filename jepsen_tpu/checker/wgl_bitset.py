@@ -64,8 +64,8 @@ from jepsen_tpu.checker.models import model as get_model
 #: out columns: alive, taint, died op index, rounds total, rounds max
 OUT_COLS = 8
 
-#: per-step meta columns: slot, live, op_index, init_state
-META_COLS = 4
+#: per-step meta columns: slot, live, op_index, init_state, fresh mask
+META_COLS = 5
 
 #: return-steps per grid iteration (amortizes per-iteration block DMA)
 STEP_BLOCK = 8
@@ -161,21 +161,18 @@ def _make_kernel(model_name: str, S: int, W: int):
     M = max((1 << W) // 32, MIN_WORDS)
     B = STEP_BLOCK
 
-    def kernel(win_ref, meta_ref, out_ref, f_ref, snap_ref):
+    def kernel(win_ref, meta_ref, fr_in_ref, out_ref, fr_out_ref,
+               f_ref, snap_ref):
         # Grid: (keys, step-blocks); steps iterate fastest, so the
         # per-key frontier resets at each key's first block.
         i = pl.program_id(1)
 
         @pl.when(i == 0)
         def _init():
-            init_state = meta_ref[0, 0, 3]
-            row = lax.broadcasted_iota(jnp.int32, (S, M), 0)
-            lane = lax.broadcasted_iota(jnp.int32, (S, M), 1)
-            # One config: the initial state row with the empty mask
-            # (mask 0 = word 0 bit 0).
-            f_ref[:] = jnp.where(
-                (row == init_state + 1) & (lane == 0), 1, 0
-            )
+            # Start from the caller-provided frontier (segment chaining
+            # hands the previous segment's final frontier in; a fresh
+            # scan passes the single init-state config).
+            f_ref[:] = fr_in_ref[0]
             out_ref[0, 0, 0] = 1  # alive
             out_ref[0, 0, 1] = 0  # taint (unconverged closure; never)
             out_ref[0, 0, 2] = -1  # died op index
@@ -188,11 +185,17 @@ def _make_kernel(model_name: str, S: int, W: int):
         for b in range(B):
             _substep(win_ref, meta_ref, out_ref, f_ref, snap_ref, b)
 
+        @pl.when(i == pl.num_programs(1) - 1)
+        def _final():
+            fr_out_ref[0] = f_ref[:]
+
     def _substep(win_ref, meta_ref, out_ref, f_ref, snap_ref, b):
         slot_r = meta_ref[0, b, 0]
         live = meta_ref[0, b, 1]
         opidx = meta_ref[0, b, 2]
         alive = out_ref[0, 0, 0]
+
+        fresh = meta_ref[0, b, 4]
 
         @pl.when((alive == 1) & (live == 1))
         def _step():
@@ -200,16 +203,22 @@ def _make_kernel(model_name: str, S: int, W: int):
             rows = lax.broadcasted_iota(jnp.int32, (S, 1), 0)
 
             # Rounds mutate the frontier ref in place so each slot's
-            # vector work sits under a pl.when on its SMEM occupancy
-            # scalar — a real branch, so unoccupied slots cost nothing
-            # (windows are mostly empty: W covers the worst step).
+            # vector work sits under a pl.when on its SMEM gate
+            # scalar — a real branch, so gated-out slots cost nothing.
+            # Round 0 expands ONLY freshly invoked slots: the frontier
+            # arrives closed under every other open op (a RETURN
+            # filter preserves closure — events.ReturnSteps.fresh), so
+            # when round 0 adds nothing the step is already done, and
+            # a full round runs only to chase chains it enabled.
             def round_fn(st):
                 _, r = st
                 snap_ref[:] = f_ref[:]
                 for w in range(W):
                     occw = win_ref[0, b, 0, w]
+                    freshw = (fresh >> w) & 1
+                    gate = jnp.where(r == 0, freshw, occw)
 
-                    @pl.when(occw == 1)
+                    @pl.when(gate == 1)
                     def _slot(w=w):
                         fw = win_ref[0, b, 1, w]
                         aw = win_ref[0, b, 2, w]
@@ -261,15 +270,32 @@ def _make_kernel(model_name: str, S: int, W: int):
     return kernel, M
 
 
+def bitset_words(W: int) -> int:
+    return max((1 << W) // 32, MIN_WORDS)
+
+
+def init_frontier(init_state, S: int, W: int) -> np.ndarray:
+    """[S, M] fresh-scan frontier: the init-state row, empty mask.
+    Built host-side (numpy): eager per-element device ops would pay a
+    tunnel round trip each."""
+    M = bitset_words(W)
+    fr = np.zeros((S, M), np.int32)
+    fr[int(init_state) + 1, 0] = 1
+    return fr
+
+
 @functools.partial(
     jax.jit, static_argnames=("model_name", "S", "W", "interpret")
 )
-def _bitset_scan(win, meta, model_name, S, W, interpret=False):
+def _bitset_scan(win, meta, fr_in, model_name, S, W, interpret=False):
     """Batched scan: win [n_keys, n, 4, W] int8 (occ/f/a/b — int8 on
     the wire to quarter the host->device transfer, widened on device),
-    meta [n_keys, n, META_COLS] int32 -> out [n_keys, 1, OUT_COLS].
-    Keys form the outer grid dimension — one launch, one host sync per
-    batch."""
+    meta [n_keys, n, META_COLS] int32, fr_in [n_keys, S, M] starting
+    frontier -> (out [n_keys, 1, OUT_COLS], fr_out [n_keys, S, M]
+    final frontier). Keys form the outer grid dimension — one launch,
+    one host sync per batch; the frontier in/out pair lets segments
+    with different W chain back-to-back on device (W12 -> W16 embeds
+    the mask space as the first 128 words)."""
     n_keys, n = win.shape[0], win.shape[1]
     B = STEP_BLOCK
     assert n % B == 0, f"steps {n} not a multiple of {B}"
@@ -289,13 +315,20 @@ def _bitset_scan(win, meta, model_name, S, W, interpret=False):
                 lambda k, i: (k, i, 0),
                 memory_space=pltpu.SMEM,
             ),
+            pl.BlockSpec((1, S, M), lambda k, i: (k, 0, 0)),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, OUT_COLS),
-            lambda k, i: (k, 0, 0),
-            memory_space=pltpu.SMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((n_keys, 1, OUT_COLS), jnp.int32),
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, OUT_COLS),
+                lambda k, i: (k, 0, 0),
+                memory_space=pltpu.SMEM,
+            ),
+            pl.BlockSpec((1, S, M), lambda k, i: (k, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_keys, 1, OUT_COLS), jnp.int32),
+            jax.ShapeDtypeStruct((n_keys, S, M), jnp.int32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((S, M), jnp.int32),
             pltpu.VMEM((S, M), jnp.int32),
@@ -304,7 +337,7 @@ def _bitset_scan(win, meta, model_name, S, W, interpret=False):
             dimension_semantics=("arbitrary", "arbitrary")
         ),
         interpret=interpret,
-    )(win, meta)
+    )(win, meta, fr_in)
 
 
 def pack_steps(steps: ReturnSteps):
@@ -320,6 +353,13 @@ def pack_steps(steps: ReturnSteps):
     meta[:, 1] = steps.live.astype(np.int32)
     meta[:, 2] = steps.op_index
     meta[:, 3] = steps.init_state
+    if steps.fresh is not None:
+        meta[:, 4] = steps.fresh[:, 0]
+    else:
+        # No fresh tracking: treat every occupied slot as fresh (round
+        # 0 becomes a full round — the pre-optimization behavior).
+        bits = (1 << np.arange(steps.W, dtype=np.int64))[None, :]
+        meta[:, 4] = (steps.occ * bits).sum(axis=1).astype(np.int32)
     win = np.stack(
         [steps.occ, steps.f, steps.a, steps.b], axis=1
     ).astype(np.int8)
@@ -351,16 +391,120 @@ def check_steps_bitset(
         win, meta = pack_steps(steps)
         args = (jnp.asarray(win[None]), jnp.asarray(meta[None]))
         steps._bitset_args = args
-    out = np.asarray(
-        _bitset_scan(
-            *args,
-            model_name=model if isinstance(model, str) else model.name,
-            S=S,
-            W=steps.W,
-            interpret=interpret,
-        )
+    fr0 = jnp.asarray(init_frontier(steps.init_state, S, steps.W)[None])
+    out, _ = _bitset_scan(
+        *args,
+        fr0,
+        model_name=model if isinstance(model, str) else model.name,
+        S=S,
+        W=steps.W,
+        interpret=interpret,
     )
-    return _out_to_verdicts(out)[0]
+    return _out_to_verdicts(np.asarray(out))[0]
+
+
+def _narrow_steps(steps: ReturnSteps, k: int, W: int) -> ReturnSteps:
+    """First k steps with the window narrowed to W slots — valid only
+    when none of them touches a slot >= W (split_point guarantees)."""
+    return ReturnSteps(
+        occ=steps.occ[:k, :W],
+        f=steps.f[:k, :W],
+        a=steps.a[:k, :W],
+        b=steps.b[:k, :W],
+        slot=steps.slot[:k],
+        live=steps.live[:k],
+        crashed=steps.crashed[:k],
+        op_index=steps.op_index[:k],
+        init_state=steps.init_state,
+        W=W,
+        fresh=(
+            steps.fresh[:k] if steps.fresh is not None else None
+        ),
+    )
+
+
+def _tail_steps(steps: ReturnSteps, k: int) -> ReturnSteps:
+    return ReturnSteps(
+        occ=steps.occ[k:],
+        f=steps.f[k:],
+        a=steps.a[k:],
+        b=steps.b[k:],
+        slot=steps.slot[k:],
+        live=steps.live[k:],
+        crashed=steps.crashed[k:],
+        op_index=steps.op_index[k:],
+        init_state=steps.init_state,
+        W=steps.W,
+        fresh=(
+            steps.fresh[k:] if steps.fresh is not None else None
+        ),
+    )
+
+
+def split_point(steps: ReturnSteps, W_low: int) -> int:
+    """Number of leading steps whose windows fit W_low slots (the
+    first step occupying or returning a slot >= W_low ends the run)."""
+    if not len(steps):
+        return 0
+    touches = (
+        np.any(steps.occ[:, W_low:], axis=1) | (steps.slot >= W_low)
+    )
+    hi = np.nonzero(touches)[0]
+    return int(hi[0]) if len(hi) else len(steps)
+
+
+@functools.partial(jax.jit, static_argnames=("S", "M_hi"))
+def _embed_frontier(fr_lo, S, M_hi):
+    """Device-side W_low -> W_high frontier embed: the low mask space
+    IS the first M_lo words of the high one (masks with high bits
+    clear are a lane prefix)."""
+    pad = M_hi - fr_lo.shape[-1]
+    return jnp.pad(fr_lo, ((0, 0), (0, 0), (0, pad)))
+
+
+def check_steps_bitset_segmented(
+    steps: ReturnSteps,
+    model: str = "cas-register",
+    S: int = 8,
+    W_low: int = 12,
+    interpret: bool = False,
+) -> Tuple[bool, bool, int]:
+    """Two-segment scan for crash-accumulating histories: the prefix
+    whose windows fit W_low slots runs on the 16x-cheaper narrow
+    kernel (M=128 words — one vreg row per op), the remainder on the
+    full-W kernel, chained through the frontier in/out pair with NO
+    host sync in between (the embed is a device-side lane pad). The
+    host combines: a prefix death wins; otherwise the tail decides."""
+    k = split_point(steps, W_low)
+    n = len(steps)
+    name = model if isinstance(model, str) else model.name
+    if k < max(n // 4, STEP_BLOCK) or k == n or steps.W <= W_low:
+        # Not worth two launches: one full-width scan, shape-bucketed.
+        steps = steps.padded(bucket(max(n, 1), 64))
+        return check_steps_bitset(
+            steps, model=model, S=S, interpret=interpret
+        )
+    lo = _narrow_steps(steps, k, W_low)
+    lo = lo.padded(bucket(max(len(lo), 1), 64))
+    hi = _tail_steps(steps, k)
+    hi = hi.padded(bucket(max(len(hi), 1), 64))
+    win1, meta1 = pack_steps(lo)
+    win2, meta2 = pack_steps(hi)
+    fr0 = jnp.asarray(init_frontier(steps.init_state, S, W_low)[None])
+    out1, fr1 = _bitset_scan(
+        jnp.asarray(win1[None]), jnp.asarray(meta1[None]), fr0,
+        model_name=name, S=S, W=W_low, interpret=interpret,
+    )
+    fr1 = _embed_frontier(fr1, S, bitset_words(steps.W))
+    out2, _ = _bitset_scan(
+        jnp.asarray(win2[None]), jnp.asarray(meta2[None]), fr1,
+        model_name=name, S=S, W=steps.W, interpret=interpret,
+    )
+    a1, t1, d1 = _out_to_verdicts(np.asarray(out1))[0]
+    a2, t2, d2 = _out_to_verdicts(np.asarray(out2))[0]
+    if not a1:
+        return False, t1 or t2, d1
+    return a2, t1 or t2, d2
 
 
 def check_keys_bitset(
@@ -374,19 +518,22 @@ def check_keys_bitset(
     compiled kernel serves every batch."""
     n = bucket(max(max(len(st) for st in steps_list), 1), 64)
     name = model if isinstance(model, str) else model.name
+    W = steps_list[0].W
     wins, metas = [], []
     for st in steps_list:
         w, m = pack_steps(st.padded(n))
         wins.append(w)
         metas.append(m)
-    out = np.asarray(
-        _bitset_scan(
-            jnp.asarray(np.stack(wins)),
-            jnp.asarray(np.stack(metas)),
-            model_name=name,
-            S=S,
-            W=steps_list[0].W,
-            interpret=interpret,
-        )
+    fr0 = jnp.asarray(np.stack([
+        init_frontier(st.init_state, S, W) for st in steps_list
+    ]))
+    out, _ = _bitset_scan(
+        jnp.asarray(np.stack(wins)),
+        jnp.asarray(np.stack(metas)),
+        fr0,
+        model_name=name,
+        S=S,
+        W=W,
+        interpret=interpret,
     )
-    return _out_to_verdicts(out)
+    return _out_to_verdicts(np.asarray(out))

@@ -214,13 +214,18 @@ class SqlBankClient(CockroachSqlClient):
                 amt, frm, to = (
                     int(v["amount"]), int(v["from"]), int(v["to"])
                 )
+                # One guarded statement (Postgres dialect — cockroach
+                # has no ROW_COUNT()): debit and credit apply together
+                # or not at all, so an insufficient balance can't mint
+                # money on the credit side.
                 self._sql(
                     test,
-                    "BEGIN; "
-                    f"UPDATE accounts SET balance = balance - {amt} "
-                    f"WHERE id = {frm} AND balance >= {amt}; "
-                    f"UPDATE accounts SET balance = balance + {amt} "
-                    f"WHERE id = {to}; COMMIT;",
+                    "UPDATE accounts SET balance = CASE "
+                    f"WHEN id = {frm} THEN balance - {amt} "
+                    f"ELSE balance + {amt} END "
+                    f"WHERE id IN ({frm}, {to}) AND "
+                    f"(SELECT balance FROM accounts WHERE id = {frm}) "
+                    f">= {amt};",
                 )
                 return op.with_(type="ok")
             raise ValueError(f"unknown op f={op.f!r}")

@@ -32,7 +32,7 @@ from jepsen_tpu.db import DB
 from jepsen_tpu.generator import pure as gen
 from jepsen_tpu.history.ops import Op
 from jepsen_tpu.os import Debian
-from jepsen_tpu.runtime.client import AtomClient, Client
+from jepsen_tpu.runtime.client import Client
 
 DIR = "/opt/hazelcast"
 JAR = f"{DIR}/hazelcast-server.jar"

@@ -189,3 +189,41 @@ def test_batch_matches_single():
 def test_wide_window_routes_out():
     assert w_bucket(17) is None or w_bucket(17) >= 17
     assert w_bucket(200) is None
+
+
+def test_segmented_scan_parity():
+    """Crash-accumulating histories split into a narrow-window prefix
+    and a wide suffix chained through the frontier; the combined
+    verdict must match both the one-shot scan and the oracle —
+    including deaths inside either segment."""
+    from jepsen_tpu.checker.wgl_bitset import (
+        check_steps_bitset_segmented,
+        split_point,
+    )
+
+    segmented_hit = 0
+    for seed in range(10):
+        rng = random.Random(4000 + seed)
+        h = gen_register_history(
+            rng, n_ops=260, n_procs=4, p_crash=0.05
+        )
+        if seed % 2:
+            h = corrupt_history(h, rng)
+        ev = history_to_events(h)
+        if ev.window <= 12 or w_bucket(ev.window) is None:
+            continue
+        W, S = _plan(ev)
+        steps = events_to_steps(ev, W=W)
+        k = split_point(steps, 12)
+        if k >= max(len(steps) // 4, 8) and k < len(steps):
+            segmented_hit += 1
+        alive, taint, died = check_steps_bitset_segmented(
+            steps, S=S, interpret=True
+        )
+        one_alive, one_taint, one_died = _check(ev)
+        want = check_events(ev)
+        assert not taint and not one_taint
+        assert alive == one_alive == want, (seed, alive, want)
+        if not alive:
+            assert died == one_died
+    assert segmented_hit >= 2  # the two-launch path actually ran

@@ -38,6 +38,18 @@ def test_cpp_tools_compile_and_compute(tmp_path):
         str(tmp_path / "strobe_time"), "--print-only", "100", "50", "4"
     )
     assert int(out.strip()) == 80
+    # experimental relative-bump strobe (shipped, not installed —
+    # jepsen/resources/strobe-time-experiment.c's role): same cadence
+    # arithmetic in --print-only mode
+    s.exec(
+        "g++", "-O2", "-o", str(tmp_path / "strobe_time_experiment"),
+        os.path.join(res, "strobe_time_experiment.cc"),
+    )
+    out = s.exec(
+        str(tmp_path / "strobe_time_experiment"),
+        "--print-only", "100", "50", "4",
+    )
+    assert int(out.strip()) == 80
 
 
 def test_clock_nemesis_command_shapes():
