@@ -31,10 +31,16 @@ import time
 
 
 def _time(fn, reps=1):
-    t0 = time.perf_counter()
+    """Best-of-reps wall time (the timeit discipline): the tunnel to
+    the TPU adds latency spikes that a mean would charge to the
+    kernel; the minimum is the reproducible cost of the computation."""
+    best = None
     for _ in range(reps):
+        t0 = time.perf_counter()
         out = fn()
-    return (time.perf_counter() - t0) / reps, out
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best, out
 
 
 def bench_config1():
@@ -304,30 +310,19 @@ def bench_north_star():
     tpu_wall, r = _time(lambda: check_events_bucketed(ev), reps=3)
     assert tpu_wall < 60, f"north-star budget blown: {tpu_wall:.1f}s"
     assert r["valid?"] is True, r
-    # Oracle on a half-history prefix, extrapolated x2. This UNDERSTATES
-    # the oracle's true cost (frontier width grows with accumulated
-    # crashed ops, so the second half is the slow half: full-history
-    # runs measured 83-133s against ~2x25s extrapolated), i.e. the
-    # reported speedup is a floor.
-    frac = 2
-    cut = len(ev.kind) // frac
-    prefix = type(ev)(
-        kind=ev.kind[:cut], slot=ev.slot[:cut], f=ev.f[:cut],
-        a=ev.a[:cut], b=ev.b[:cut], window=ev.window,
-        init_state=ev.init_state, n_ops=ev.n_ops // frac,
-        value_codes=ev.value_codes, op_index=ev.op_index[:cut],
-    )
-    sub_wall, want = _time(lambda: oracle(prefix))
-    # Parity cross-check on the SAME input (the bench doubles as a
-    # correctness gate).
-    assert check_events_bucketed(prefix)["valid?"] == want is True
+    # Full-history oracle, measured (not extrapolated — the frontier
+    # widens as crashed ops accumulate, so prefix extrapolation would
+    # understate it ~2x). Costs ~47 s of bench wall-clock; the verdict
+    # doubles as the parity gate on the exact north-star input.
+    oracle_wall, want = _time(lambda: oracle(ev))
+    assert want is True and r["valid?"] == want
     return {
         "name": "northstar-100k",
         "n_ops": ev.n_ops,
         "tpu_wall": tpu_wall,
-        "oracle_wall": sub_wall * frac,
-        "method": f"{r['method']} (oracle extrapolated from 1/{frac} "
-                  "prefix)",
+        "oracle_wall": oracle_wall,
+        "method": f"{r['method']} (oracle measured on the full "
+                  "history)",
     }
 
 

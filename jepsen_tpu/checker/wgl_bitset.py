@@ -64,8 +64,9 @@ from jepsen_tpu.checker.models import model as get_model
 #: out columns: alive, taint, died op index, rounds total, rounds max
 OUT_COLS = 8
 
-#: per-step meta columns: slot, live, op_index, init_state, fresh mask
-META_COLS = 5
+#: per-step meta columns: slot, live, op_index, fresh mask (the init
+#: state travels as the fr_in frontier, not per-step meta)
+META_COLS = 4
 
 #: return-steps per grid iteration (amortizes per-iteration block DMA)
 STEP_BLOCK = 8
@@ -195,7 +196,7 @@ def _make_kernel(model_name: str, S: int, W: int):
         opidx = meta_ref[0, b, 2]
         alive = out_ref[0, 0, 0]
 
-        fresh = meta_ref[0, b, 4]
+        fresh = meta_ref[0, b, 3]
 
         @pl.when((alive == 1) & (live == 1))
         def _step():
@@ -352,14 +353,13 @@ def pack_steps(steps: ReturnSteps):
     meta[:, 0] = steps.slot
     meta[:, 1] = steps.live.astype(np.int32)
     meta[:, 2] = steps.op_index
-    meta[:, 3] = steps.init_state
     if steps.fresh is not None:
-        meta[:, 4] = steps.fresh[:, 0]
+        meta[:, 3] = steps.fresh[:, 0]
     else:
         # No fresh tracking: treat every occupied slot as fresh (round
         # 0 becomes a full round — the pre-optimization behavior).
         bits = (1 << np.arange(steps.W, dtype=np.int64))[None, :]
-        meta[:, 4] = (steps.occ * bits).sum(axis=1).astype(np.int32)
+        meta[:, 3] = (steps.occ * bits).sum(axis=1).astype(np.int32)
     win = np.stack(
         [steps.occ, steps.f, steps.a, steps.b], axis=1
     ).astype(np.int8)
@@ -500,8 +500,9 @@ def check_steps_bitset_segmented(
         jnp.asarray(win2[None]), jnp.asarray(meta2[None]), fr1,
         model_name=name, S=S, W=steps.W, interpret=interpret,
     )
-    a1, t1, d1 = _out_to_verdicts(np.asarray(out1))[0]
-    a2, t2, d2 = _out_to_verdicts(np.asarray(out2))[0]
+    o1, o2 = jax.device_get((out1, out2))  # ONE fetch for both syncs
+    a1, t1, d1 = _out_to_verdicts(np.asarray(o1))[0]
+    a2, t2, d2 = _out_to_verdicts(np.asarray(o2))[0]
     if not a1:
         return False, t1 or t2, d1
     return a2, t1 or t2, d2

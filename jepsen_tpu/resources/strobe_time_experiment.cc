@@ -46,6 +46,10 @@ int main(int argc, char **argv) {
     return 2;
   }
   long long delta_ms = args[0], period_ms = args[1], duration_s = args[2];
+  if (period_ms <= 0) {
+    fprintf(stderr, "period-ms must be positive, got %lld\n", period_ms);
+    return 2;
+  }
 
   if (print_only) {
     printf("%lld\n", duration_s * 1000LL / period_ms);
