@@ -181,6 +181,59 @@ class IptablesNet(Net):
         on_nodes(test, fn, [n for n in grudge])
 
 
+class IpfilterNet(IptablesNet):
+    """SmartOS/illumos backend (net.clj:111-143): partitions via ipf
+    rules piped on stdin, sources resolved to IPs through the
+    inherited getent memo. The tc/netem verbs are Linux-only, so
+    slow/flaky raise rather than silently run a missing binary; fast
+    is the heal-side no-op."""
+
+    def drop(self, test, src, dest) -> None:
+        from jepsen_tpu.control.core import sessions_for
+
+        sess = sessions_for(test)[dest]
+        ip = self._ip(test, sess, src)
+        sess.exec(
+            "sh", "-c", "ipf -f -", sudo=True,
+            stdin=f"block in from {ip} to any\n",
+        )
+
+    def heal(self, test) -> None:
+        def fn(node, sess):
+            sess.exec("ipf", "-Fa", sudo=True)
+
+        on_nodes(test, fn)
+
+    def drop_all(self, test, grudge) -> None:
+        def fn(node, sess):
+            srcs = list(grudge.get(node, ()))
+            if not srcs:
+                return
+            rules = "".join(
+                f"block in from {self._ip(test, sess, s)} to any\n"
+                for s in srcs
+            )
+            sess.exec("sh", "-c", "ipf -f -", sudo=True, stdin=rules)
+
+        on_nodes(test, fn, [n for n in grudge])
+
+    def slow(self, test, **kw) -> None:
+        raise NotImplementedError(
+            "tc/netem is Linux-only; illumos has no slow! backend "
+            "(the reference's ipfilter impl emits the same Linux tc "
+            "commands there — net.clj:121-134 — which cannot work; "
+            "this port surfaces the limitation instead)"
+        )
+
+    def flaky(self, test) -> None:
+        raise NotImplementedError(
+            "tc/netem is Linux-only; illumos has no flaky! backend"
+        )
+
+    def fast(self, test) -> None:
+        pass  # nothing to undo: slow/flaky are unsupported
+
+
 def drop_all(test, grudge) -> None:
     """Apply a grudge map {node: nodes-to-drop-traffic-from} through
     the test's net (net.clj:28-43)."""

@@ -23,6 +23,19 @@ class OS:
     def teardown(self, test, node: str, session: Session) -> None:
         pass
 
+    def setup_hostfile(self, test, node: str, session: Session) -> None:
+        """Map every test node name in /etc/hosts (os/debian.clj's
+        hostfile fix — OS-independent, so every flavor shares it)."""
+        lines = ["127.0.0.1 localhost"]
+        for n in test.get("nodes", []):
+            ip = test.get("node_ips", {}).get(n)
+            if ip:
+                lines.append(f"{ip} {n}")
+        content = "\n".join(lines) + "\n"
+        session.exec(
+            "sh", "-c", "cat > /etc/hosts", sudo=True, stdin=content
+        )
+
 
 noop = OS
 
@@ -59,15 +72,50 @@ class Debian(OS):
             )
         self.setup_hostfile(test, node, session)
 
-    def setup_hostfile(self, test, node: str, session: Session) -> None:
-        """Map every test node name in /etc/hosts
-        (os/debian.clj's hostfile fix)."""
-        lines = ["127.0.0.1 localhost"]
-        for i, n in enumerate(test.get("nodes", [])):
-            ip = test.get("node_ips", {}).get(n)
-            if ip:
-                lines.append(f"{ip} {n}")
-        content = "\n".join(lines) + "\n"
+
+class Ubuntu(Debian):
+    """Ubuntu setup (os/ubuntu.clj): the Debian recipe verbatim — the
+    reference's ubuntu namespace delegates to debian with a different
+    sources.list, which the image provides here."""
+
+
+class Centos(OS):
+    """RHEL-family setup (os/centos.clj): same base tooling over yum."""
+
+    BASE_PACKAGES = (
+        "curl", "iptables", "psmisc", "tar", "unzip", "iputils",
+        "iproute", "logrotate",
+    )
+
+    def __init__(self, extra_packages: Iterable[str] = ()):
+        self.packages = list(self.BASE_PACKAGES) + list(extra_packages)
+
+    def setup(self, test, node: str, session: Session) -> None:
         session.exec(
-            "sh", "-c", "cat > /etc/hosts", sudo=True, stdin=content
+            "yum", "install", "-y", *self.packages, sudo=True,
+            check=False,
         )
+        self.setup_hostfile(test, node, session)
+
+
+class SmartOS(OS):
+    """SmartOS/illumos setup (os/smartos.clj): pkgin tooling; the net
+    plane pairs with IpfilterNet (net.clj:111-143) since there is no
+    iptables."""
+
+    BASE_PACKAGES = ("curl", "gtar", "unzip")
+
+    def __init__(self, extra_packages: Iterable[str] = ()):
+        self.packages = list(self.BASE_PACKAGES) + list(extra_packages)
+
+    def setup(self, test, node: str, session: Session) -> None:
+        session.exec(
+            "pkgin", "-y", "install", *self.packages, sudo=True,
+            check=False,
+        )
+        # ipfilter must be enabled for the partition nemesis
+        session.exec(
+            "svcadm", "enable", "network/ipfilter", sudo=True,
+            check=False,
+        )
+        self.setup_hostfile(test, node, session)

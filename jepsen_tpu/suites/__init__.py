@@ -8,18 +8,29 @@ shared CLI, following the canonical 197-line etcd shape
 """
 
 from jepsen_tpu.suites import (
+    aerospike,
+    chronos,
     cockroachdb,
     consul,
+    crate,
+    dgraph,
+    elasticsearch,
     etcd,
+    faunadb,
     galera,
     hazelcast,
     mongodb,
+    percona,
     rabbitmq,
+    simple,
     tidb,
+    yugabyte,
     zookeeper,
 )
 
 __all__ = [
-    "cockroachdb", "consul", "etcd", "galera", "hazelcast", "mongodb",
-    "rabbitmq", "tidb", "zookeeper",
+    "aerospike", "chronos", "cockroachdb", "consul", "crate",
+    "dgraph", "elasticsearch", "etcd", "faunadb", "galera",
+    "hazelcast", "mongodb", "percona", "rabbitmq", "simple", "tidb",
+    "yugabyte", "zookeeper",
 ]

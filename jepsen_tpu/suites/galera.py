@@ -140,37 +140,120 @@ class GaleraBankClient(Client):
             raise
 
 
+class GaleraDirtyReadsClient(Client):
+    """Real-mode dirty-reads client (dirty_reads.clj:28-67): writers
+    set every row in one serializable transaction via the mysql CLI;
+    readers select all rows."""
+
+    def __init__(self, node=None, n_rows: int = 8):
+        self.node = node
+        self.n_rows = n_rows
+
+    def open(self, test, node):
+        return GaleraDirtyReadsClient(node, self.n_rows)
+
+    def _sql(self, test, stmt: str) -> str:
+        sess = sessions_for(test)[self.node]
+        return sess.exec(
+            "mysql", "-h", self.node, "-u", "root",
+            f"-p{PASSWORD}", "--batch", "--raw", "-e", stmt, "jepsen",
+        )
+
+    def setup(self, test):
+        rows = ",".join(f"({i},-1)" for i in range(self.n_rows))
+        try:
+            self._sql(
+                test,
+                "CREATE TABLE IF NOT EXISTS dirty "
+                "(id INT PRIMARY KEY, x BIGINT NOT NULL); "
+                f"INSERT IGNORE INTO dirty VALUES {rows};",
+            )
+        except Exception:
+            pass  # another worker's setup won the race
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                out = self._sql(test, "SELECT x FROM dirty ORDER BY id;")
+                vals = [
+                    int(line) for line in out.splitlines()[1:]
+                    if line.strip()
+                ]
+                return op.with_(type="ok", value=vals)
+            if op.f == "write":
+                self._sql(
+                    test,
+                    "SET SESSION TRANSACTION ISOLATION LEVEL "
+                    "SERIALIZABLE; BEGIN; "
+                    f"UPDATE dirty SET x = {int(op.value)}; COMMIT;",
+                )
+                return op.with_(type="ok")
+            raise ValueError(f"unknown op f={op.f!r}")
+        except ValueError:
+            raise
+        except Exception as e:
+            if op.f == "read":
+                raise ClientFailed(str(e))
+            raise
+
+
+def _bank_workload(opts):
+    from jepsen_tpu.workloads import bank
+
+    return bank.workload(n_ops=opts.get("ops", 400), rng=opts.get("rng"))
+
+
+def _dirty_reads_workload(opts):
+    from jepsen_tpu.workloads import dirty_reads
+
+    return dirty_reads.workload(
+        n_ops=opts.get("ops", 200),
+        weak=opts.get("weak", False),
+        rng=opts.get("rng"),
+    )
+
+
+WORKLOADS = {
+    "bank": _bank_workload,
+    "dirty-reads": _dirty_reads_workload,
+}
+
+#: real-mode SQL clients per workload (dummy mode keeps the workload's
+#: in-memory client)
+REAL_CLIENTS = {
+    "bank": GaleraBankClient,
+    "dirty-reads": GaleraDirtyReadsClient,
+}
+
+
 def galera_test(opts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     opts = dict(opts or {})
     rng = opts.pop("rng", None) or random.Random(opts.pop("seed", 0))
+    opts.setdefault("rng", rng)
     dummy = opts.pop("dummy", False)
-    n_ops = opts.pop("ops", 400)
     time_limit_s = opts.pop("time_limit", None)
+    workload_name = opts.pop("workload", "bank")
 
-    from jepsen_tpu.workloads import bank
-
-    spec = bank.workload(n_ops=n_ops, rng=rng)
-    # workload generators arrive thread-scoped already — no rewrap
+    spec = WORKLOADS[workload_name](opts)
     generator = spec["generator"]
     if time_limit_s:
         generator = gen.time_limit(time_limit_s, generator)
     test: Dict[str, Any] = {
-        "name": "galera",
+        "name": f"galera-{workload_name}",
         "os": Debian(),
         "db": GaleraDB(),
-        "client": GaleraBankClient(),
         "net": netlib.IptablesNet(),
         "nemesis": nemlib.partition_random_halves(rng=rng),
+        **spec,
         "generator": generator,
-        "checker": spec["checker"],
-        "accounts": spec.get("accounts", list(range(8))),
-        "total_amount": spec.get("total_amount", 100),
     }
+    if not dummy:
+        test["client"] = REAL_CLIENTS[workload_name]()
     if dummy:
         test.pop("os")
         test.pop("db")
-        test["client"] = spec["client"]
         test["net"] = netlib.MemNet()
+    opts.pop("rng", None)
     test.update(opts)
     return test
 
@@ -182,6 +265,8 @@ def main(argv=None) -> int:
 
     p = argparse.ArgumentParser(prog="jepsen_tpu.suites.galera")
     p.add_argument("--nodes", default="n1,n2,n3,n4,n5")
+    p.add_argument("--workload", default="bank",
+                   choices=sorted(WORKLOADS))
     p.add_argument("--ops", type=int, default=400)
     p.add_argument("--time-limit", type=float, default=30.0)
     p.add_argument("--concurrency", type=int, default=5)
@@ -190,6 +275,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     test = galera_test({
         "dummy": args.dummy,
+        "workload": args.workload,
         "ops": args.ops,
         "nodes": [n for n in args.nodes.split(",") if n],
         "time_limit": args.time_limit,

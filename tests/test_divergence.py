@@ -1,0 +1,274 @@
+"""Dirty-read / version-divergence / schedule checker tests, plus
+dummy-mode end-to-end runs of the chronos, crate, elasticsearch,
+percona, and galera dirty-reads suites — each weak mode provably
+caught by its checker."""
+
+import random
+
+import pytest
+
+from jepsen_tpu.checker.divergence import (
+    DirtyReadsChecker,
+    MultiVersionChecker,
+    StrongDirtyReadChecker,
+)
+from jepsen_tpu.checker.schedule import (
+    ScheduleChecker,
+    job_solution,
+    job_targets,
+)
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.ops import fail_op, invoke_op, ok_op
+from jepsen_tpu.runtime import run
+from jepsen_tpu.suites import chronos, crate, elasticsearch, percona
+
+
+# -- dirty reads (galera shape) ---------------------------------------------
+
+
+def test_dirty_reads_checker_clean_and_filthy():
+    c = DirtyReadsChecker()
+    clean = History([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "read"), ok_op(1, "read", [1, 1, 1]),
+        invoke_op(0, "write", 2), fail_op(0, "write", 2),
+        invoke_op(1, "read"), ok_op(1, "read", [1, 1, 1]),
+    ])
+    r = c.check({}, clean)
+    assert r["valid?"] is True and not r["dirty_reads"]
+
+    filthy = History([
+        invoke_op(0, "write", 2), fail_op(0, "write", 2),
+        invoke_op(1, "read"), ok_op(1, "read", [2, 1, 1]),
+    ])
+    r = c.check({}, filthy)
+    assert r["valid?"] is False
+    assert r["dirty_reads"][0]["failed_values"] == [2]
+    assert r["inconsistent_reads"]  # torn as well
+
+
+# -- strong dirty read (crate shape) ----------------------------------------
+
+
+def test_strong_dirty_read_checker():
+    c = StrongDirtyReadChecker()
+    ok_h = History([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "read"), ok_op(1, "read", 1),
+        invoke_op(0, "strong-read"), ok_op(0, "strong-read", [1]),
+        invoke_op(1, "strong-read"), ok_op(1, "strong-read", [1]),
+    ])
+    r = c.check({}, ok_h)
+    assert r["valid?"] is True and r["nodes-agree?"] is True
+
+    # lost: acked write 2 on no strong set; dirty: read 3 never strong
+    bad = History([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(0, "write", 2), ok_op(0, "write", 2),
+        invoke_op(1, "read"), ok_op(1, "read", 3),
+        invoke_op(0, "strong-read"), ok_op(0, "strong-read", [1]),
+        invoke_op(1, "strong-read"), ok_op(1, "strong-read", [1, 4]),
+    ])
+    r = c.check({}, bad)
+    assert r["valid?"] is False
+    assert r["lost"] == [2] and r["dirty"] == [3]
+    assert r["nodes-agree?"] is False and r["not-on-all"] == [4]
+
+
+# -- multiversion ------------------------------------------------------------
+
+
+def test_multiversion_checker():
+    c = MultiVersionChecker()
+    ok_h = History([
+        invoke_op(0, "read"),
+        ok_op(0, "read", {"value": 1, "_version": 1}),
+        invoke_op(1, "read"),
+        ok_op(1, "read", {"value": 2, "_version": 2}),
+        invoke_op(0, "read"),
+        ok_op(0, "read", {"value": 1, "_version": 1}),
+    ])
+    assert c.check({}, ok_h)["valid?"] is True
+
+    bad = History([
+        invoke_op(0, "read"),
+        ok_op(0, "read", {"value": 1, "_version": 1}),
+        invoke_op(1, "read"),
+        ok_op(1, "read", {"value": 9, "_version": 1}),
+    ])
+    r = c.check({}, bad)
+    assert r["valid?"] is False and r["multis"] == {1: [1, 9]}
+
+
+# -- schedule (chronos shape) -----------------------------------------------
+
+
+def test_job_targets_cutoff():
+    job = {"name": "j", "start": 0.0, "interval": 60.0, "count": 5,
+           "epsilon": 10.0, "duration": 1.0}
+    t = job_targets(job, read_time=200.0)
+    # starts < 200 - 10 - 1 = 189: 0, 60, 120, 180
+    assert list(t) == [0.0, 60.0, 120.0, 180.0]
+
+
+def test_job_solution_matching():
+    job = {"name": "j", "start": 0.0, "interval": 60.0, "count": 4,
+           "epsilon": 10.0, "duration": 1.0}
+    runs = [
+        {"start": 2.0, "end": 3.0},
+        {"start": 61.0, "end": 62.0},
+        {"start": 122.0, "end": 123.0},
+    ]
+    # read at 170: cutoff 159, so targets are 0/60/120 (180 not yet due)
+    r = job_solution(job, 170.0, runs)
+    assert r["valid?"] is True and not r["extra"]
+
+    # a missed target: no run near 60
+    r = job_solution(job, 170.0, [runs[0], runs[2]])
+    assert r["valid?"] is False
+    assert r["solution"][60.0] is None
+
+    # incomplete runs never satisfy
+    r = job_solution(job, 170.0, [
+        {"start": 2.0, "end": 3.0},
+        {"start": 61.0},  # began, never finished
+        {"start": 122.0, "end": 123.0},
+    ])
+    assert r["valid?"] is False and r["incomplete"] == [61.0]
+
+    # a run outside every window is extra
+    r = job_solution(job, 170.0, runs + [{"start": 45.0, "end": 46.0}])
+    assert r["valid?"] is True and r["extra"] == [45.0]
+
+
+def test_schedule_checker_unknown_without_read():
+    h = History([
+        invoke_op(0, "add-job"),
+        ok_op(0, "add-job", {"name": "j", "start": 0.0,
+                             "interval": 60.0, "count": 2,
+                             "epsilon": 10.0, "duration": 1.0}),
+    ])
+    assert ScheduleChecker().check({}, h)["valid?"] == "unknown"
+
+
+# -- suite end-to-end (dummy) -----------------------------------------------
+
+
+def test_chronos_dummy_valid_and_weak():
+    test = chronos.chronos_test({
+        "dummy": True, "jobs": 4, "rng": random.Random(1),
+        "nodes": ["n1", "n2", "n3"],
+    })
+    test["concurrency"] = 3
+    r = run(test)["results"]
+    assert r["valid?"] is True, r
+    assert r["job_count"] == 4 and r["run_count"] > 0
+
+    test = chronos.chronos_test({
+        "dummy": True, "jobs": 4, "weak": True,
+        "rng": random.Random(2), "nodes": ["n1", "n2", "n3"],
+    })
+    test["concurrency"] = 3
+    r = run(test)["results"]
+    assert r["valid?"] is False, r
+    missed = [
+        s for s in r["jobs"].values() if not s["valid?"]
+    ]
+    assert missed and any(
+        None in s["solution"].values() for s in missed
+    )
+
+
+@pytest.mark.parametrize("workload", sorted(crate.WORKLOADS))
+def test_crate_dummy_workloads(workload):
+    for weak, want in ((False, True), (True, False)):
+        test = crate.crate_test({
+            "dummy": True, "workload": workload, "ops": 120,
+            "weak": weak, "rng": random.Random(3),
+            "nodes": ["n1", "n2", "n3"],
+        })
+        test["concurrency"] = 4
+        r = run(test)["results"]
+        assert r["valid?"] is want, (workload, weak, r)
+
+
+def test_elasticsearch_dummy_sets():
+    for weak, want in ((False, True), (True, False)):
+        test = elasticsearch.elasticsearch_test({
+            "dummy": True, "workload": "sets", "ops": 150,
+            "weak": weak, "rng": random.Random(4),
+            "nodes": ["n1", "n2", "n3"],
+        })
+        test["concurrency"] = 4
+        r = run(test)["results"]
+        assert r["valid?"] is want, (weak, r)
+
+
+def test_percona_dummy_dirty_reads():
+    for weak, want in ((False, True), (True, False)):
+        test = percona.percona_test({
+            "dummy": True, "workload": "dirty-reads", "ops": 150,
+            "weak": weak, "rng": random.Random(5),
+            "nodes": ["n1", "n2", "n3"],
+        })
+        test["concurrency"] = 4
+        r = run(test)["results"]
+        assert r["valid?"] is want, (weak, r)
+        if weak:
+            assert r["dirty_reads"]
+
+
+def test_galera_dirty_reads_workload():
+    from jepsen_tpu.suites import galera
+
+    test = galera.galera_test({
+        "dummy": True, "workload": "dirty-reads", "ops": 150,
+        "weak": True, "rng": random.Random(6),
+        "nodes": ["n1", "n2", "n3"],
+    })
+    test["concurrency"] = 4
+    r = run(test)["results"]
+    assert r["valid?"] is False and r["dirty_reads"]
+
+
+def test_percona_db_commands():
+    from jepsen_tpu.control import DummyRemote
+    from jepsen_tpu.control.core import sessions_for
+
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2"], "remote": remote}
+    db = percona.PerconaDB()
+    sess = sessions_for(test)
+    db.setup(test, "n1", sess["n1"])
+    assert any(
+        "bootstrap-pxc" in c for c in remote.commands("n1")
+    )
+    db.setup(test, "n2", sess["n2"])
+    assert any(
+        "gcomm://n1,n2" in c for c in remote.commands("n2")
+    )
+
+
+def test_chronos_db_and_rest_client_commands():
+    from jepsen_tpu.control import DummyRemote
+    from jepsen_tpu.control.core import sessions_for
+    from jepsen_tpu.history.ops import invoke_op as inv
+
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2", "n3"], "remote": remote}
+    db = chronos.ChronosDB()
+    sess = sessions_for(test)
+    db.setup(test, "n1", sess["n1"])
+    cmds = remote.commands("n1")
+    assert any("mesos-master" in c and "--quorum 2" in c for c in cmds)
+    assert any("chronos" in c and "--zk_hosts" in c for c in cmds)
+
+    c = chronos.ChronosRestClient().open(test, "n1")
+    job = {"name": "j1", "start": 0.0, "interval": 60.0, "count": 3,
+           "epsilon": 10.0, "duration": 2.0}
+    out = c.invoke(test, inv(0, "add-job", job))
+    assert out.type == "ok"
+    assert any(
+        "scheduler/iso8601" in c2 and "R3//PT60" in c2.replace(".0", "")
+        for c2 in remote.commands("n1")
+    )

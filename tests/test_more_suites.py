@@ -1,0 +1,235 @@
+"""yugabyte / dgraph / faunadb / aerospike / simple-registry suite
+tests: dummy-mode end-to-end runs, distinctive features (tracing
+spans, topology nemesis, component routing), and real-mode command
+shapes against the recording dummy control plane."""
+
+import json
+import random
+
+import pytest
+
+from jepsen_tpu.control import DummyRemote
+from jepsen_tpu.control.core import sessions_for
+from jepsen_tpu.history.ops import invoke_op
+from jepsen_tpu.runtime import run
+from jepsen_tpu.suites import (
+    aerospike,
+    dgraph,
+    faunadb,
+    simple,
+    yugabyte,
+)
+
+
+# -- yugabyte ----------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "workload", ["bank", "counter", "set", "long-fork"]
+)
+def test_yugabyte_dummy_workloads(workload):
+    test = yugabyte.yugabyte_test({
+        "dummy": True, "workload": workload, "ops": 120,
+        "nodes": ["n1", "n2", "n3"], "rng": random.Random(1),
+    })
+    test["concurrency"] = 4
+    r = run(test)["results"]
+    assert r["valid?"] is True, (workload, r)
+
+
+def test_yugabyte_weak_counter_caught():
+    test = yugabyte.yugabyte_test({
+        "dummy": True, "workload": "counter", "ops": 600,
+        "weak": True, "nodes": ["n1", "n2", "n3"],
+        "rng": random.Random(2),
+    })
+    test["concurrency"] = 4
+    r = run(test)["results"]
+    assert r["valid?"] is False, r
+
+
+def test_yugabyte_db_and_component_nemesis():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2", "n3"], "remote": remote,
+            "barrier": None}
+    db = yugabyte.YugabyteDB()
+    sess = sessions_for(test)
+    db.setup(test, "n1", sess["n1"])
+    cmds = remote.commands("n1")
+    assert any("yb-master" in c and
+               "--master_addresses=n1:7100,n2:7100,n3:7100" in c
+               for c in cmds)
+    assert any("yb-tserver" in c for c in cmds)
+
+    nem = yugabyte.ComponentNemesis(db, rng=random.Random(3))
+    out = nem.invoke(test, invoke_op("nemesis", "kill-tserver"))
+    assert out.type == "info" and out.value
+    out = nem.invoke(test, invoke_op("nemesis", "resume-master"))
+    assert set(out.value) == {"n1", "n2", "n3"}
+
+
+# -- dgraph ------------------------------------------------------------------
+
+
+def test_dgraph_dummy_with_trace_spans(tmp_path):
+    test = dgraph.dgraph_test({
+        "dummy": True, "workload": "bank", "ops": 80,
+        "nodes": ["n1", "n2", "n3"], "rng": random.Random(4),
+    })
+    test["concurrency"] = 4
+    test["run_dir"] = str(tmp_path)
+    r = run(test)["results"]
+    assert r["valid?"] is True, r
+    spans = [
+        json.loads(line)
+        for line in (tmp_path / "trace.jsonl").read_text().splitlines()
+    ]
+    assert len(spans) >= 80
+    assert {"trace", "name", "process", "start_us", "duration_us",
+            "outcome"} <= set(spans[0])
+    assert any(s["name"] == "read" for s in spans)
+    # raises trace as "exception" (the runtime converts them to
+    # :info/:fail downstream of the client)
+    assert all(s["outcome"] in ("ok", "fail", "info", "exception")
+               for s in spans)
+
+
+def test_dgraph_db_commands():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2"], "remote": remote, "barrier": None}
+    db = dgraph.DgraphDB()
+    sess = sessions_for(test)
+    db.setup(test, "n2", sess["n2"])
+    cmds = remote.commands("n2")
+    assert any("dgraph zero" in c and "--peer=n1:5080" in c
+               for c in cmds)
+    assert any("dgraph alpha" in c and "--zero=n1:5080" in c
+               for c in cmds)
+
+
+# -- faunadb -----------------------------------------------------------------
+
+
+def test_faunadb_topology_nemesis_preserves_majority():
+    nem = faunadb.TopologyNemesis(rng=random.Random(5))
+    test = {"dummy": True, "nodes": ["n1", "n2", "n3", "n4", "n5"]}
+    nem.setup(test)
+    removed = 0
+    for _ in range(6):
+        out = nem.invoke(test, invoke_op("nemesis", "remove-node"))
+        if out.value != "at-minimum":
+            removed += 1
+    # 5 nodes, majority 3: at most 2 removable
+    assert removed == 2
+    assert len(test["active_nodes"]) == 3
+    assert "n1" in test["active_nodes"]  # the seed never leaves
+    out = nem.invoke(test, invoke_op("nemesis", "add-node"))
+    assert out.value[0] == "added"
+    assert len(test["active_nodes"]) == 4
+
+
+def test_faunadb_dummy_run_through_resizes():
+    test = faunadb.faunadb_test({
+        "dummy": True, "workload": "register", "keys": 3,
+        "per_key_ops": 12, "nemesis_interval": 0.1,
+        "time_limit": 2.5, "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "rng": random.Random(6),
+    })
+    test["concurrency"] = 6
+    out = run(test)
+    r = out["results"]
+    assert r["valid?"] is True, r
+    topo_ops = [o for o in out["history"].ops
+                if o.process == "nemesis" and o.type == "info"]
+    assert any(
+        isinstance(o.value, list) and o.value[0] == "removed"
+        for o in topo_ops
+    )
+
+
+# -- aerospike ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("workload", ["cas-register", "counter", "set"])
+def test_aerospike_dummy_workloads(workload):
+    test = aerospike.aerospike_test({
+        "dummy": True, "workload": workload, "ops": 120,
+        "nodes": ["n1", "n2", "n3"], "rng": random.Random(7),
+    })
+    test["concurrency"] = 4
+    r = run(test)["results"]
+    assert r["valid?"] is True, (workload, r)
+
+
+def test_aerospike_db_config():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2"], "remote": remote}
+    db = aerospike.AerospikeDB()
+    sess = sessions_for(test)
+    db.setup(test, "n1", sess["n1"])
+    cmds = remote.commands("n1")
+    assert any("mesh-seed-address-port n2 3002" in c for c in cmds)
+    assert any("asd" in c and "--config-file" in c for c in cmds)
+
+
+# -- simple registry ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("suite", sorted(simple.SUITES))
+def test_simple_suites_dummy(suite):
+    test = simple.make_test(suite, {
+        "dummy": True, "ops": 80,
+        "nodes": ["n1", "n2", "n3"], "rng": random.Random(8),
+    })
+    test["concurrency"] = 4
+    r = run(test)["results"]
+    assert r["valid?"] is True, (suite, r)
+
+
+def test_simple_registry_real_commands():
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2", "n3"], "remote": remote}
+    sess = sessions_for(test)
+    simple.SUITES["disque"]["db"].setup(test, "n1", sess["n1"])
+    cmds = remote.commands("n1")
+    assert any("git clone" in c and "disque" in c for c in cmds)
+    assert any("disque-server" in c for c in cmds)
+
+    remote2 = DummyRemote()
+    test2 = {"nodes": ["n1", "n2"], "remote": remote2}
+    sess2 = sessions_for(test2)
+    simple.SUITES["rethinkdb"]["db"].setup(test2, "n2", sess2["n2"])
+    cmds2 = remote2.commands("n2")
+    assert any("--join n1:29015" in c for c in cmds2)
+
+
+def test_simple_postgres_rds_has_no_node_automation():
+    test = simple.make_test("postgres-rds", {
+        "nodes": ["rds-endpoint"], "rng": random.Random(9),
+    })
+    assert "db" not in test and "os" not in test
+
+
+def test_smartos_flavor_uses_ipfilter():
+    from jepsen_tpu import net as netlib
+
+    test = simple.make_test("mongodb-smartos", {
+        "nodes": ["n1"], "rng": random.Random(10),
+    })
+    assert isinstance(test["net"], netlib.IpfilterNet)
+    from jepsen_tpu.os import SmartOS
+
+    assert isinstance(test["os"], SmartOS)
+
+
+def test_ipfilter_net_commands():
+    from jepsen_tpu import net as netlib
+
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2"], "remote": remote}
+    net = netlib.IpfilterNet()
+    net.drop(test, "n1", "n2")
+    cmds = remote.commands("n2")
+    assert any("ipf -f -" in c for c in cmds)
+    net.heal(test)
+    assert any("ipf -Fa" in c for c in remote.commands("n1"))
