@@ -83,6 +83,11 @@ class DirtyReadsChecker:
                     "failed_values": [it.rev[c] for c in seen],
                 })
         return {
+            # Reference parity (dirty_reads.clj:94): only dirty reads
+            # fail the verdict; inconsistent (torn) reads are reported
+            # but non-fatal — the workload's writers overlap, so torn
+            # reads occur even under serializability when a read lands
+            # between two committed full-table writes.
             "valid?": not dirty,
             "read_count": len(read_rows),
             "failed_write_count": int(failed.size),

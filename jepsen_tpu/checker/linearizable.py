@@ -148,6 +148,28 @@ def check_events_bucketed(
             }
             if not alive:
                 out["failed_op_index"] = died
+                fr = getattr(bsteps, "_death_frontier", None)
+                if fr is not None:
+                    from jepsen_tpu.checker.wgl_bitset import (
+                        decode_frontier,
+                    )
+
+                    rev = {
+                        c: k for k, c in events.value_codes.items()
+                    }
+
+                    def dec(c):
+                        if c < 0:
+                            return None
+                        k = rev.get(c)
+                        # intern keys are ("int", 2)-style tuples
+                        if isinstance(k, tuple) and len(k) == 2:
+                            return k[1]
+                        return k
+
+                    out["failure"] = decode_frontier(
+                        fr, bsteps, died, model, decode_value=dec
+                    )
             return out
     if W is None or not m.jax_capable:
         # Too concurrent for the masks, or the model's state doesn't

@@ -71,14 +71,29 @@ META_COLS = 4
 #: return-steps per grid iteration (amortizes per-iteration block DMA)
 STEP_BLOCK = 8
 
+
+def step_block(W: int) -> int:
+    """Substeps per grid iteration: 1 at W=20 — the unrolled kernel
+    body over 32768-lane tensors is otherwise too much program for
+    Mosaic to compile in reasonable time."""
+    return STEP_BLOCK if W <= 16 else 1
+
 #: mask-word lane floor: smaller windows still use full vector lanes
 MIN_WORDS = 128
 
-#: supported window buckets (2^W/32 words: 128 and 2048 lanes)
+#: supported window buckets (2^W/32 words: 128 and 2048 lanes).
+#: W=20 was attempted and abandoned: Mosaic does not finish compiling
+#: the closure kernel over 32768-lane tensors in any reasonable time
+#: (>10 min even with a 1-substep grid), so windows past 16 route to
+#: the K-frontier ladder instead.
 W_BUCKETS = (12, 16)
 
 #: state-row cap (VMEM: 32 x 2048 x 4 B = 256 KB at W=16)
 MAX_ROWS = 32
+
+#: VMEM budget for the two [S, M] frontier scratches (v5e scoped vmem
+#: is ~16 MiB; at W=20 this caps S at 16 rows)
+_VMEM_BYTES = 4 * 1024 * 1024
 
 _U = np.uint32
 #: in-word mask-bit patterns: _C1[k] has bit beta set iff beta & (1<<k)
@@ -112,6 +127,8 @@ def plan(m, window: int, n_value_codes: int) -> Tuple[int, int] | None:
     S = _rows_bucket(m.bitset_rows(n_value_codes))
     if S > MAX_ROWS:
         return None
+    if 2 * 4 * S * bitset_words(W) > _VMEM_BYTES:
+        return None  # frontier scratches would blow scoped VMEM
     return W, S
 
 
@@ -160,7 +177,7 @@ def _make_kernel(model_name: str, S: int, W: int):
     bitset_slot = get_model(model_name).bitset_slot_jax
     assert bitset_slot is not None, model_name
     M = max((1 << W) // 32, MIN_WORDS)
-    B = STEP_BLOCK
+    B = step_block(W)
 
     def kernel(win_ref, meta_ref, fr_in_ref, out_ref, fr_out_ref,
                f_ref, snap_ref):
@@ -184,13 +201,19 @@ def _make_kernel(model_name: str, S: int, W: int):
             out_ref[0, 0, 7] = 0
 
         for b in range(B):
-            _substep(win_ref, meta_ref, out_ref, f_ref, snap_ref, b)
+            _substep(win_ref, meta_ref, out_ref, fr_out_ref, f_ref,
+                     snap_ref, b)
 
-        @pl.when(i == pl.num_programs(1) - 1)
+        @pl.when(
+            (i == pl.num_programs(1) - 1) & (out_ref[0, 0, 0] == 1)
+        )
         def _final():
+            # alive only: a death already wrote its pre-filter
+            # frontier artifact into fr_out
             fr_out_ref[0] = f_ref[:]
 
-    def _substep(win_ref, meta_ref, out_ref, f_ref, snap_ref, b):
+    def _substep(win_ref, meta_ref, out_ref, fr_out_ref, f_ref,
+                 snap_ref, b):
         slot_r = meta_ref[0, b, 0]
         live = meta_ref[0, b, 1]
         opidx = meta_ref[0, b, 2]
@@ -256,7 +279,8 @@ def _make_kernel(model_name: str, S: int, W: int):
 
             # RETURN filter: keep configs with the returning op
             # linearized, clear its bit (frees the slot).
-            fr = _remove_bit_dyn(f_ref[:], slot_r, lane1, M)
+            pre = f_ref[:]
+            fr = _remove_bit_dyn(pre, slot_r, lane1, M)
             f_ref[:] = fr
 
             @pl.when(changed)
@@ -267,6 +291,11 @@ def _make_kernel(model_name: str, S: int, W: int):
             def _died():
                 out_ref[0, 0, 0] = 0
                 out_ref[0, 0, 2] = opidx
+                # Failure artifact: the competing configs the filter
+                # killed — every state/mask the search still considered
+                # possible when the returning op proved impossible
+                # (checker.clj:146-154's reporting role).
+                fr_out_ref[0] = pre
 
     return kernel, M
 
@@ -298,7 +327,7 @@ def _bitset_scan(win, meta, fr_in, model_name, S, W, interpret=False):
     with different W chain back-to-back on device (W12 -> W16 embeds
     the mask space as the first 128 words)."""
     n_keys, n = win.shape[0], win.shape[1]
-    B = STEP_BLOCK
+    B = step_block(W)
     assert n % B == 0, f"steps {n} not a multiple of {B}"
     kernel, M = _make_kernel(model_name, S, W)
     win = win.astype(jnp.int32)
@@ -392,7 +421,7 @@ def check_steps_bitset(
         args = (jnp.asarray(win[None]), jnp.asarray(meta[None]))
         steps._bitset_args = args
     fr0 = jnp.asarray(init_frontier(steps.init_state, S, steps.W)[None])
-    out, _ = _bitset_scan(
+    out, fr = _bitset_scan(
         *args,
         fr0,
         model_name=model if isinstance(model, str) else model.name,
@@ -400,43 +429,34 @@ def check_steps_bitset(
         W=steps.W,
         interpret=interpret,
     )
-    return _out_to_verdicts(np.asarray(out))[0]
+    verdict = _out_to_verdicts(np.asarray(out))[0]
+    if not verdict[0]:
+        # death artifact: the pre-filter frontier (decode_frontier)
+        steps._death_frontier = np.asarray(fr)[0]
+    return verdict
 
 
-def _narrow_steps(steps: ReturnSteps, k: int, W: int) -> ReturnSteps:
-    """First k steps with the window narrowed to W slots — valid only
-    when none of them touches a slot >= W (split_point guarantees)."""
+def _slice_steps(
+    steps: ReturnSteps, start: int, end: int, W: int
+) -> ReturnSteps:
+    """Steps [start, end) with the window narrowed to W slots — valid
+    only when none of them touches a slot >= W (split_point
+    guarantees)."""
     return ReturnSteps(
-        occ=steps.occ[:k, :W],
-        f=steps.f[:k, :W],
-        a=steps.a[:k, :W],
-        b=steps.b[:k, :W],
-        slot=steps.slot[:k],
-        live=steps.live[:k],
-        crashed=steps.crashed[:k],
-        op_index=steps.op_index[:k],
+        occ=steps.occ[start:end, :W],
+        f=steps.f[start:end, :W],
+        a=steps.a[start:end, :W],
+        b=steps.b[start:end, :W],
+        slot=steps.slot[start:end],
+        live=steps.live[start:end],
+        crashed=steps.crashed[start:end],
+        op_index=steps.op_index[start:end],
         init_state=steps.init_state,
         W=W,
         fresh=(
-            steps.fresh[:k] if steps.fresh is not None else None
-        ),
-    )
-
-
-def _tail_steps(steps: ReturnSteps, k: int) -> ReturnSteps:
-    return ReturnSteps(
-        occ=steps.occ[k:],
-        f=steps.f[k:],
-        a=steps.a[k:],
-        b=steps.b[k:],
-        slot=steps.slot[k:],
-        live=steps.live[k:],
-        crashed=steps.crashed[k:],
-        op_index=steps.op_index[k:],
-        init_state=steps.init_state,
-        W=steps.W,
-        fresh=(
-            steps.fresh[k:] if steps.fresh is not None else None
+            steps.fresh[start:end]
+            if steps.fresh is not None
+            else None
         ),
     )
 
@@ -462,50 +482,146 @@ def _embed_frontier(fr_lo, S, M_hi):
     return jnp.pad(fr_lo, ((0, 0), (0, 0), (0, pad)))
 
 
+def plan_segments(steps: ReturnSteps) -> List[Tuple[int, int, int]]:
+    """[(start, end, W)] segments: for each narrower bucket, the
+    leading run of steps whose windows fit it forms a cheaper segment
+    (per-op cost scales with 2^W). A segment must be worth its launch
+    (>= max(n/8, STEP_BLOCK) steps)."""
+    n = len(steps)
+    segs: List[Tuple[int, int, int]] = []
+    start = 0
+    for b in W_BUCKETS:
+        if b >= steps.W:
+            break
+        k = split_point(steps, b)
+        if k - start >= max(n // 8, STEP_BLOCK):
+            segs.append((start, k, b))
+            start = k
+    segs.append((start, n, steps.W))
+    return segs
+
+
 def check_steps_bitset_segmented(
     steps: ReturnSteps,
     model: str = "cas-register",
     S: int = 8,
-    W_low: int = 12,
     interpret: bool = False,
 ) -> Tuple[bool, bool, int]:
-    """Two-segment scan for crash-accumulating histories: the prefix
-    whose windows fit W_low slots runs on the 16x-cheaper narrow
-    kernel (M=128 words — one vreg row per op), the remainder on the
-    full-W kernel, chained through the frontier in/out pair with NO
-    host sync in between (the embed is a device-side lane pad). The
-    host combines: a prefix death wins; otherwise the tail decides."""
-    k = split_point(steps, W_low)
-    n = len(steps)
+    """Multi-segment scan for crash-accumulating histories: the prefix
+    runs on the narrowest kernel its windows fit (per-op cost scales
+    16x per bucket), widening as crashed slots pile up, all segments
+    chained through the frontier in/out pair with NO host sync in
+    between (the embed is a device-side lane pad — a narrow mask space
+    is a lane prefix of the wide one). The host fetches every
+    segment's verdict in one device_get; the first death wins."""
+    segs = plan_segments(steps)
     name = model if isinstance(model, str) else model.name
-    if k < max(n // 4, STEP_BLOCK) or k == n or steps.W <= W_low:
-        # Not worth two launches: one full-width scan, shape-bucketed.
-        steps = steps.padded(bucket(max(n, 1), 64))
-        return check_steps_bitset(
-            steps, model=model, S=S, interpret=interpret
+    if len(segs) == 1:
+        # Not worth multiple launches: one scan, shape-bucketed.
+        padded = steps.padded(bucket(max(len(steps), 1), 64))
+        verdict = check_steps_bitset(
+            padded, model=model, S=S, interpret=interpret
         )
-    lo = _narrow_steps(steps, k, W_low)
-    lo = lo.padded(bucket(max(len(lo), 1), 64))
-    hi = _tail_steps(steps, k)
-    hi = hi.padded(bucket(max(len(hi), 1), 64))
-    win1, meta1 = pack_steps(lo)
-    win2, meta2 = pack_steps(hi)
-    fr0 = jnp.asarray(init_frontier(steps.init_state, S, W_low)[None])
-    out1, fr1 = _bitset_scan(
-        jnp.asarray(win1[None]), jnp.asarray(meta1[None]), fr0,
-        model_name=name, S=S, W=W_low, interpret=interpret,
-    )
-    fr1 = _embed_frontier(fr1, S, bitset_words(steps.W))
-    out2, _ = _bitset_scan(
-        jnp.asarray(win2[None]), jnp.asarray(meta2[None]), fr1,
-        model_name=name, S=S, W=steps.W, interpret=interpret,
-    )
-    o1, o2 = jax.device_get((out1, out2))  # ONE fetch for both syncs
-    a1, t1, d1 = _out_to_verdicts(np.asarray(o1))[0]
-    a2, t2, d2 = _out_to_verdicts(np.asarray(o2))[0]
-    if not a1:
-        return False, t1 or t2, d1
-    return a2, t1 or t2, d2
+        fr = getattr(padded, "_death_frontier", None)
+        if fr is not None:
+            steps._death_frontier = fr
+        return verdict
+    fr = jnp.asarray(init_frontier(steps.init_state, S, segs[0][2])[None])
+    outs = []
+    frs = []
+    for start, end, W in segs:
+        sub = _slice_steps(steps, start, end, W)
+        sub = sub.padded(bucket(max(len(sub), 1), 64))
+        win, meta = pack_steps(sub)
+        fr = _embed_frontier(fr, S, bitset_words(W))
+        out, fr = _bitset_scan(
+            jnp.asarray(win[None]), jnp.asarray(meta[None]), fr,
+            model_name=name, S=S, W=W, interpret=interpret,
+        )
+        outs.append(out)
+        frs.append(fr)
+    fetched = jax.device_get(tuple(outs))  # ONE fetch for all syncs
+    taint = False
+    for o, dead_fr in zip(fetched, frs):
+        alive, t, died = _out_to_verdicts(np.asarray(o))[0]
+        taint = taint or t
+        if not alive:
+            steps._death_frontier = np.asarray(dead_fr)[0]
+            return False, taint, died
+    return True, taint, -1
+
+
+def decode_frontier(
+    fr: np.ndarray,
+    steps: ReturnSteps,
+    died_op_index: int,
+    model,
+    decode_value=None,
+    max_configs: int = 10,
+) -> dict:
+    """Decode a death's pre-filter frontier into the reference-style
+    failure report (checker.clj:146-158, truncated to 10 configs):
+    the returning op that could not linearize, and each surviving
+    config's state + which open ops it had/hadn't linearized."""
+    from jepsen_tpu.checker.models import model as get_model
+
+    m = get_model(model)
+    f_names: dict = {}
+    for name, code in m.f_names.items():
+        f_names.setdefault(code, str(name))
+    dec = decode_value or (lambda c: c)
+
+    rows = np.nonzero(steps.op_index == died_op_index)[0]
+    if not len(rows):
+        return {"configs": [], "note": "death step not found"}
+    i = int(rows[0])
+    W = steps.W
+
+    def op_desc(slot: int) -> dict:
+        d = {
+            "slot": slot,
+            "f": f_names.get(int(steps.f[i, slot]), "?"),
+            "value": dec(int(steps.a[i, slot])),
+        }
+        if d["f"] in ("cas", "compare-and-set"):
+            d["value"] = [
+                dec(int(steps.a[i, slot])), dec(int(steps.b[i, slot]))
+            ]
+        return d
+
+    configs = []
+    S, M = fr.shape
+    for s in range(S):
+        if len(configs) >= max_configs:
+            break
+        words = np.nonzero(fr[s])[0]
+        for w in words:
+            word = int(fr[s, w])
+            for b in range(32):
+                if not (word >> b) & 1:
+                    continue
+                mask = int(w) * 32 + b
+                linearized = [
+                    op_desc(j) for j in range(W)
+                    if (mask >> j) & 1 and steps.occ[i, j]
+                ]
+                pending = [
+                    op_desc(j) for j in range(W)
+                    if not (mask >> j) & 1 and steps.occ[i, j]
+                ]
+                configs.append({
+                    "state": dec(s - 1) if s > 0 else None,
+                    "linearized": linearized,
+                    "pending": pending,
+                })
+                if len(configs) >= max_configs:
+                    break
+            if len(configs) >= max_configs:
+                break
+    return {
+        "failed_op": op_desc(int(steps.slot[i])),
+        "configs": configs,
+    }
 
 
 def check_keys_bitset(

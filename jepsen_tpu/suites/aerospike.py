@@ -32,12 +32,12 @@ class AerospikeDB(DB):
             f"mesh-seed-address-port {n} 3002" for n in test["nodes"]
         )
         conf = (
-            "service {{ paxos-single-replica-limit 1 }}\\n"
-            "network {{ heartbeat {{ mode mesh\\n"
+            "service { paxos-single-replica-limit 1 }\\n"
+            "network { heartbeat { mode mesh\\n"
             f"{mesh}\\n"
-            "}} }}\\n"
-            "namespace jepsen {{ replication-factor 3\\n"
-            "storage-engine memory }}\\n"
+            "} }\\n"
+            "namespace jepsen { replication-factor 3\\n"
+            "storage-engine memory }\\n"
         )
         session.exec(
             "sh", "-c",

@@ -95,16 +95,23 @@ class ChronosRestClient(Client):
         try:
             if op.f == "add-job":
                 job = op.value
+                name = str(job["name"])
+                # Each run logs "<name> <start>" when it begins and
+                # "<name> <start> <end>" when it completes — the shape
+                # the read parser and the checker's incomplete-run
+                # accounting consume.
+                cmd = (
+                    f"s=$(date +%s); echo {name} $s >> "
+                    f"{DIR}/runs.log && sleep {job['duration']} && "
+                    f"echo {name} $s $(date +%s) >> {DIR}/runs.log"
+                )
                 spec = {
-                    "name": str(job["name"]),
+                    "name": name,
                     "schedule": (
                         f"R{job['count']}//PT{job['interval']}S"
                     ),
                     "epsilon": f"PT{job['epsilon']}S",
-                    "command": (
-                        f"echo $(date +%s) >> {DIR}/runs-"
-                        f"{job['name']}.log && sleep {job['duration']}"
-                    ),
+                    "command": cmd,
                 }
                 sess.exec(
                     "curl", "-f", "-X", "POST",
@@ -113,21 +120,27 @@ class ChronosRestClient(Client):
                     f"http://{self.node}:4400/scheduler/iso8601",
                 )
                 return op.with_(type="ok")
+            if op.f == "advance-clock":
+                return op.with_(type="ok")  # real time advances itself
             if op.f == "read":
                 out = sess.exec(
                     "sh", "-c",
-                    f"cat {DIR}/runs-*.log 2>/dev/null || true",
+                    f"cat {DIR}/runs.log 2>/dev/null || true",
                 )
-                runs = []
+                begun = {}
+                done = {}
                 for line in out.splitlines():
                     parts = line.split()
-                    if len(parts) >= 2:
-                        runs.append({
-                            "name": parts[0],
-                            "start": float(parts[1]),
-                            "end": float(parts[2])
-                            if len(parts) > 2 else None,
-                        })
+                    if len(parts) == 2:
+                        begun[(parts[0], float(parts[1]))] = None
+                    elif len(parts) == 3:
+                        done[(parts[0], float(parts[1]))] = float(
+                            parts[2]
+                        )
+                runs = [
+                    {"name": n, "start": s, "end": done.get((n, s))}
+                    for (n, s) in begun
+                ]
                 import time as _t
 
                 return op.with_(
@@ -194,9 +207,14 @@ class MemSchedulerClient(Client):
         raise ValueError(f"unknown op f={op.f!r}")
 
 
-def job_generator(n_jobs: int = 6, horizon_s: float = 600.0):
-    """Add n_jobs jobs with varied cadences, advance the (simulated)
-    clock past the horizon, then one final read."""
+def job_generator(
+    n_jobs: int = 6,
+    horizon_s: float = 600.0,
+    simulated: bool = True,
+):
+    """Add n_jobs jobs with varied cadences, let the horizon pass
+    (advance the simulated clock in dummy mode; sleep real time
+    against a live cluster), then one final read."""
     jobs = [
         {
             "name": f"job-{i}",
@@ -209,9 +227,14 @@ def job_generator(n_jobs: int = 6, horizon_s: float = 600.0):
         for i in range(n_jobs)
     ]
     adds = [gen.once({"f": "add-job", "value": j}) for j in jobs]
+    wait = (
+        gen.clients(gen.once({"f": "advance-clock", "value": horizon_s}))
+        if simulated
+        else gen.clients([gen.sleep(horizon_s)])
+    )
     return gen.phases(
         gen.clients(adds),
-        gen.clients(gen.once({"f": "advance-clock", "value": horizon_s})),
+        wait,
         gen.clients(gen.once({"f": "read"})),
     )
 
@@ -222,6 +245,7 @@ def chronos_test(opts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     dummy = opts.pop("dummy", False)
     n_jobs = opts.pop("jobs", 6)
     weak = opts.pop("weak", False)
+    horizon = opts.pop("horizon", 600.0)
 
     test: Dict[str, Any] = {
         "name": "chronos",
@@ -230,7 +254,9 @@ def chronos_test(opts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         "client": ChronosRestClient(),
         "net": netlib.IptablesNet(),
         "nemesis": nemlib.partition_random_halves(rng=rng),
-        "generator": job_generator(n_jobs),
+        "generator": job_generator(
+            n_jobs, horizon_s=horizon, simulated=dummy
+        ),
         "checker": ScheduleChecker(),
     }
     if dummy:

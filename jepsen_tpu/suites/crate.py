@@ -86,6 +86,145 @@ class CrateSqlClient(Client):
         )
         return json.loads(out or "{}")
 
+    def _rows(self, test, stmt: str, args: list = ()) -> list:
+        return self._sql(test, stmt, args).get("rows", [])
+
+
+class SqlDirtyReadClient(CrateSqlClient):
+    """Real-mode dirty-read client (dirty_read.clj's role): writes
+    insert rows, reads fetch the latest, strong reads refresh then
+    scan everything."""
+
+    def open(self, test, node):
+        return SqlDirtyReadClient(node)
+
+    def setup(self, test):
+        try:
+            self._sql(
+                test,
+                "CREATE TABLE IF NOT EXISTS dirty "
+                "(id INT PRIMARY KEY) WITH (number_of_replicas = 2)",
+            )
+        except Exception:
+            pass
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "write":
+                self._sql(
+                    test, "INSERT INTO dirty (id) VALUES (?)",
+                    [int(op.value)],
+                )
+                return op.with_(type="ok")
+            if op.f == "read":
+                rows = self._rows(
+                    test,
+                    "SELECT id FROM dirty ORDER BY id DESC LIMIT 1",
+                )
+                if not rows:
+                    return op.with_(type="fail")
+                return op.with_(type="ok", value=int(rows[0][0]))
+            if op.f == "strong-read":
+                self._sql(test, "REFRESH TABLE dirty")
+                rows = self._rows(test, "SELECT id FROM dirty")
+                return op.with_(
+                    type="ok", value=[int(r[0]) for r in rows]
+                )
+            raise ValueError(f"unknown op f={op.f!r}")
+        except ValueError:
+            raise
+        except Exception as e:
+            if op.f in ("read", "strong-read"):
+                raise ClientFailed(str(e))
+            raise
+
+
+class SqlVersionClient(CrateSqlClient):
+    """Real-mode version-divergence client
+    (version_divergence.clj:58-72): upserts one register row, reads
+    (value, _version)."""
+
+    def open(self, test, node):
+        return SqlVersionClient(node)
+
+    def setup(self, test):
+        try:
+            self._sql(
+                test,
+                "CREATE TABLE IF NOT EXISTS registers "
+                "(id INT PRIMARY KEY, value INT)",
+            )
+        except Exception:
+            pass
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "write":
+                self._sql(
+                    test,
+                    "INSERT INTO registers (id, value) VALUES (0, ?) "
+                    "ON DUPLICATE KEY UPDATE value = VALUES(value)",
+                    [int(op.value)],
+                )
+                return op.with_(type="ok")
+            if op.f == "read":
+                rows = self._rows(
+                    test,
+                    'SELECT value, "_version" FROM registers '
+                    "WHERE id = 0",
+                )
+                if not rows:
+                    return op.with_(type="fail")
+                return op.with_(type="ok", value={
+                    "value": rows[0][0], "_version": rows[0][1],
+                })
+            raise ValueError(f"unknown op f={op.f!r}")
+        except ValueError:
+            raise
+        except Exception as e:
+            if op.f == "read":
+                raise ClientFailed(str(e))
+            raise
+
+
+class SqlLostUpdatesClient(CrateSqlClient):
+    """Real-mode lost-updates client (lost_updates.clj's role)."""
+
+    def open(self, test, node):
+        return SqlLostUpdatesClient(node)
+
+    def setup(self, test):
+        try:
+            self._sql(
+                test,
+                "CREATE TABLE IF NOT EXISTS updates "
+                "(id INT PRIMARY KEY)",
+            )
+        except Exception:
+            pass
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                self._sql(
+                    test, "INSERT INTO updates (id) VALUES (?)",
+                    [int(op.value)],
+                )
+                return op.with_(type="ok")
+            if op.f == "read":
+                self._sql(test, "REFRESH TABLE updates")
+                rows = self._rows(test, "SELECT id FROM updates")
+                return op.with_(
+                    type="ok", value=[int(r[0]) for r in rows]
+                )
+            raise ValueError(f"unknown op f={op.f!r}")
+        except ValueError:
+            raise
+        except Exception as e:
+            if op.f == "read":
+                raise ClientFailed(str(e))
+            raise
+
 
 # -- in-memory clients -------------------------------------------------------
 
@@ -268,6 +407,14 @@ WORKLOADS: Dict[str, Callable[[dict], dict]] = {
     "lost-updates": _lost_updates_workload,
 }
 
+#: real-mode SQL clients per workload (dummy mode keeps the in-memory
+#: clients with their plantable anomalies)
+REAL_CLIENTS: Dict[str, Callable[[], Client]] = {
+    "dirty-read": SqlDirtyReadClient,
+    "version-divergence": SqlVersionClient,
+    "lost-updates": SqlLostUpdatesClient,
+}
+
 
 def crate_test(opts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     opts = dict(opts or {})
@@ -285,6 +432,8 @@ def crate_test(opts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         "nemesis": nemlib.partition_random_halves(rng=rng),
         **{k: v for k, v in spec.items()},
     }
+    if not dummy:
+        test["client"] = REAL_CLIENTS[workload_name]()
     if dummy:
         test.pop("os")
         test.pop("db")

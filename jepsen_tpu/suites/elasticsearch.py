@@ -111,6 +111,62 @@ class EsSetClient(Client):
             raise
 
 
+class EsDirtyReadClient(EsSetClient):
+    """Real-mode dirty-read client (elasticsearch/dirty_read.clj's
+    role): writes index docs, reads fetch the newest, strong reads
+    refresh then search everything."""
+
+    def open(self, test, node):
+        return EsDirtyReadClient(node)
+
+    def invoke(self, test, op: Op) -> Op:
+        base = f"http://{self.node}:9200/jepsen/dirty"
+        try:
+            if op.f == "write":
+                self._curl(
+                    test, "-X", "POST",
+                    "-H", "Content-Type: application/json",
+                    "-d", json.dumps({"value": op.value}),
+                    f"{base}?refresh=wait_for",
+                )
+                return op.with_(type="ok")
+            if op.f == "read":
+                out = self._curl(
+                    test,
+                    f"{base}/_search?size=1&sort=value:desc&q=*:*",
+                )
+                hits = json.loads(out or "{}").get("hits", {}).get(
+                    "hits", []
+                )
+                if not hits:
+                    return op.with_(type="fail")
+                return op.with_(
+                    type="ok", value=hits[0]["_source"]["value"]
+                )
+            if op.f == "strong-read":
+                self._curl(
+                    test, "-X", "POST",
+                    f"http://{self.node}:9200/jepsen/_refresh",
+                )
+                out = self._curl(
+                    test, f"{base}/_search?size=10000&q=*:*"
+                )
+                hits = json.loads(out or "{}").get("hits", {}).get(
+                    "hits", []
+                )
+                return op.with_(
+                    type="ok",
+                    value=[h["_source"]["value"] for h in hits],
+                )
+            raise ValueError(f"unknown op f={op.f!r}")
+        except ValueError:
+            raise
+        except Exception as e:
+            if op.f in ("read", "strong-read"):
+                raise ClientFailed(str(e))
+            raise
+
+
 def _sets_workload(opts):
     from jepsen_tpu.workloads import set as set_wl
 
@@ -152,8 +208,12 @@ def elasticsearch_test(
         "nemesis": nemlib.partition_random_halves(rng=rng),
         **spec,
     }
-    if workload_name == "sets" and not dummy:
-        test["client"] = EsSetClient()
+    if not dummy:
+        if workload_name == "sets":
+            test["client"] = EsSetClient()
+        else:  # dirty-read: the crate _sql family doesn't apply; ES
+            # speaks the same REST shapes as its own set client
+            test["client"] = EsDirtyReadClient()
     if dummy:
         test.pop("os")
         test.pop("db")

@@ -109,8 +109,16 @@ def percona_test(opts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         "nemesis": nemlib.partition_random_halves(rng=rng),
         **spec,
     }
-    if workload_name == "bank" and not dummy:
-        test["client"] = GaleraBankClient()
+    if not dummy:
+        # Percona speaks the same SQL on :3306 — reuse the galera
+        # clients for both workloads (the suite docstring's promise)
+        from jepsen_tpu.suites.galera import GaleraDirtyReadsClient
+
+        test["client"] = (
+            GaleraBankClient()
+            if workload_name == "bank"
+            else GaleraDirtyReadsClient()
+        )
     if dummy:
         test.pop("os")
         test.pop("db")

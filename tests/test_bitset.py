@@ -191,6 +191,50 @@ def test_wide_window_routes_out():
     assert w_bucket(200) is None
 
 
+def test_death_artifact_decodes_competing_configs():
+    """A False verdict carries the pre-filter frontier; decoding it
+    names the impossible op and the configs the search still held
+    (checker.clj:146-158's failure report role)."""
+    from jepsen_tpu.checker.wgl_bitset import (
+        check_steps_bitset_segmented,
+        decode_frontier,
+    )
+
+    h = History([
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(1, "write", 2),
+        invoke_op(0, "read"),
+        ok_op(0, "read", 7),  # 7 was never written: dies here
+    ])
+    ev = history_to_events(h)
+    W, S = _plan(ev)
+    steps = events_to_steps(ev, W=W)
+    alive, taint, died = check_steps_bitset_segmented(
+        steps, S=S, interpret=True
+    )
+    assert alive is False and not taint and died == 4
+    fr = steps._death_frontier
+    rev = {c: k for k, c in ev.value_codes.items()}
+    art = decode_frontier(
+        fr, steps, died, "cas-register",
+        decode_value=lambda c: None if c < 0 else rev[c][1],
+    )
+    assert art["failed_op"]["f"] == "read"
+    assert art["failed_op"]["value"] == 7
+    assert art["configs"], art
+    states = {c["state"] for c in art["configs"]}
+    # the register could have been 1 (write-2 pending) or 2 (linearized)
+    assert states <= {1, 2}
+    pend = [
+        op["value"] for c in art["configs"] for op in c["pending"]
+    ]
+    lin = [
+        op["value"] for c in art["configs"] for op in c["linearized"]
+    ]
+    assert 2 in pend or 2 in lin  # the open write-2 shows up either way
+
+
 def test_segmented_scan_parity():
     """Crash-accumulating histories split into a narrow-window prefix
     and a wide suffix chained through the frontier; the combined
