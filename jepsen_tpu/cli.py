@@ -26,7 +26,10 @@ EXIT_UNKNOWN = 2
 EXIT_CRASH = 254
 EXIT_USAGE = 255
 
-WORKLOADS = ("register", "register-keyed", "bank", "long-fork", "g2", "set")
+WORKLOADS = (
+    "register", "register-keyed", "bank", "long-fork", "g2", "set",
+    "counter", "monotonic", "dirty-reads",
+)
 
 
 def parse_concurrency(spec: str, n_nodes: int) -> int:
@@ -65,6 +68,18 @@ def _workload_spec(args, rng: random.Random) -> Dict[str, Any]:
         from jepsen_tpu.workloads import set as set_wl
 
         return set_wl.workload(n_adds=args.ops, rng=rng)
+    if name == "counter":
+        from jepsen_tpu.workloads import counter
+
+        return counter.workload(n_ops=args.ops, rng=rng)
+    if name == "monotonic":
+        from jepsen_tpu.workloads import monotonic
+
+        return monotonic.workload(n_ops=args.ops, rng=rng)
+    if name == "dirty-reads":
+        from jepsen_tpu.workloads import dirty_reads
+
+        return dirty_reads.workload(n_ops=args.ops, rng=rng)
     raise ValueError(f"unknown workload {name!r}")
 
 
@@ -72,9 +87,11 @@ def _checker_for(workload: str):
     from jepsen_tpu import independent
     from jepsen_tpu.checker.adya import G2Checker
     from jepsen_tpu.checker.bank import BankChecker
+    from jepsen_tpu.checker.divergence import DirtyReadsChecker
     from jepsen_tpu.checker.linearizable import LinearizableChecker
     from jepsen_tpu.checker.longfork import LongForkChecker
-    from jepsen_tpu.checker.reductions import SetFullChecker
+    from jepsen_tpu.checker.monotonic import MonotonicChecker
+    from jepsen_tpu.checker.reductions import CounterChecker, SetFullChecker
     from jepsen_tpu.workloads.adya import _KVG2Checker
 
     return {
@@ -86,6 +103,9 @@ def _checker_for(workload: str):
         "bank": BankChecker(),
         "long-fork": LongForkChecker(2),
         "g2": _KVG2Checker(),
+        "counter": CounterChecker(),
+        "monotonic": MonotonicChecker(),
+        "dirty-reads": DirtyReadsChecker(),
     }[workload]
 
 

@@ -106,6 +106,23 @@ def test_cli_test_and_analyze_roundtrip(tmp_path):
     assert st.load_results(run_dir)["valid?"] is True
 
 
+def test_cli_new_workload_families_roundtrip(tmp_path):
+    """counter / monotonic / dirty-reads flow through test + analyze
+    like the original families."""
+    store_root = str(tmp_path / "store")
+    for w in ("counter", "monotonic", "dirty-reads"):
+        code = main([
+            "test", "--workload", w, "--ops", "60",
+            "--store", store_root, "--name", f"cli-{w}", "--seed", "3",
+        ])
+        assert code == EXIT_VALID, w
+        code = main([
+            "analyze", f"cli-{w}", "--workload", w,
+            "--store", store_root,
+        ])
+        assert code == EXIT_VALID, w
+
+
 def test_cli_invalid_run_exits_1(tmp_path, monkeypatch):
     # Store a hand-made invalid register history, then analyze it.
     store_root = str(tmp_path / "store")
