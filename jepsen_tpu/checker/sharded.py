@@ -5,7 +5,8 @@ on the control node (jepsen/src/jepsen/independent.clj:266-288,
 checker.clj:90-119). Here the same independence structure maps onto the
 hardware: per-key return-step tensors are stacked into [n_keys, n, W]
 arrays, `vmap` batches the WGL frontier scan across keys, and
-`shard_map` over a 1-D device mesh splits the key axis across TPU chips
+`shard_map` over a device mesh (1-D, or multi-axis like hosts x chips
+for DCN x ICI layouts) splits the key axis across TPU chips
 so each device checks its shard over ICI-local memory. No collectives
 are needed during the scan — keys are independent by construction; the
 verdict gather is implicit in shard_map's output spec.
@@ -107,12 +108,20 @@ _wgl_vmap = functools.partial(
 )(_vmap_scan)
 
 
+def key_spec(mesh: Mesh) -> P:
+    """The one key-axis sharding: keys split across EVERY mesh axis (a
+    multi-axis mesh — e.g. ("hosts", "chips") for DCN x ICI — shards
+    keys over the full device product; keys are independent, so the
+    layout needs no collectives either way). Both the shard_map
+    in_specs and the input device_put MUST use this."""
+    return P(tuple(mesh.axis_names))
+
+
 @functools.lru_cache(maxsize=None)
 def make_sharded_checker(mesh: Mesh, model_name: str, K: int, W: int):
     """Build (and cache) a jit'd function checking stacked key columns
-    with the key axis sharded across the mesh's first axis."""
-    axis = mesh.axis_names[0]
-    spec = P(axis)
+    with the key axis sharded per key_spec."""
+    spec = key_spec(mesh)
 
     def per_shard(occ, f, a, b, slot, live, crashed, op_index, init_state):
         return _vmap_scan(
@@ -254,8 +263,7 @@ def check_keys(
         from jax.sharding import NamedSharding
 
         cols = stack_streams(streams, W=W, n_keys=n_keys)
-        spec = P(mesh.axis_names[0])
-        sharding = NamedSharding(mesh, spec)
+        sharding = NamedSharding(mesh, key_spec(mesh))
         args = tuple(jax.device_put(np.asarray(c), sharding) for c in cols)
         fn = make_sharded_checker(mesh, model, K, W)
         alive, overflow, died = fn(*args)

@@ -45,6 +45,23 @@ def test_sharded_mesh_matches_oracle():
         assert r["valid?"] == oracle_check(s)
 
 
+def test_sharded_2d_mesh_matches_oracle():
+    """Keys shard over the product of a multi-axis mesh (the hosts x
+    chips / DCN x ICI layout) — same verdicts as the oracle."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = Mesh(
+        np.asarray(devs[:8]).reshape(4, 2),
+        axis_names=("hosts", "chips"),
+    )
+    streams = _streams(11)
+    results = check_keys(streams, mesh=mesh)
+    assert len(results) == 11
+    for s, r in zip(streams, results):
+        assert r["valid?"] == oracle_check(s)
+
+
 def test_graft_entry_contract():
     import __graft_entry__ as g
 
