@@ -227,6 +227,72 @@ def test_nemesis_ops_are_journaled():
     assert any(o.value == "partitioned" for o in nem_ops)
 
 
+def test_lifecycle_stage_errors():
+    """Every remaining lifecycle stage's failure semantics (the
+    reference's worker-error-test coverage, core_test.clj:154-178,
+    with this runtime's documented recover-where-possible divergence):
+    setup errors journal synthetic fails and retry like opens; nemesis
+    invoke errors become :info entries; teardown errors never mask the
+    run's results."""
+    # client setup() raising -> synthetic fail pair, retried next op
+    class FailingSetupClient(Client):
+        def __init__(self, state=None):
+            self.state = state if state is not None else {
+                "n": 2, "lock": threading.Lock(),
+            }
+
+        def open(self, test, node):
+            return FailingSetupClient(self.state)
+
+        def setup(self, test):
+            with self.state["lock"]:
+                if self.state["n"] > 0:
+                    self.state["n"] -= 1
+                    raise RuntimeError("schema not ready")
+
+        def invoke(self, test, op):
+            return op.with_(type="ok", value=1)
+
+    test = run({
+        "client": FailingSetupClient(),
+        "generator": gen.limit(20, {"f": "read"}),
+        "concurrency": 2,
+    })
+    h = test["history"]
+    assert sum(1 for o in h.ops if o.type == "fail" and o.error) == 2
+    assert sum(1 for o in h.ops if o.type == "ok") == 18
+
+    # nemesis invoke raising -> :info entry, run completes
+    class ExplodingNemesis:
+        def invoke(self, test, op):
+            raise RuntimeError("nemesis blew up")
+
+    test = run({
+        "client": AtomClient(),
+        "nemesis": ExplodingNemesis(),
+        "generator": gen.any_gen(
+            register_gen(10),
+            gen.nemesis(gen.limit(1, {"f": "start"})),
+        ),
+        "concurrency": 2,
+    })
+    nem = [o for o in test["history"].ops if o.process == "nemesis"]
+    assert any(o.type == "info" and o.error for o in nem)
+    assert test["results"]["valid?"] is True
+
+    # client teardown raising is swallowed; results still come back
+    class FailingTeardownClient(AtomClient):
+        def teardown(self, test):
+            raise RuntimeError("teardown exploded")
+
+    test = run({
+        "client": FailingTeardownClient(),
+        "generator": gen.limit(10, {"f": "read"}),
+        "concurrency": 2,
+    })
+    assert test["results"]["valid?"] is True
+
+
 def test_time_limited_run_terminates():
     test = run({
         "client": AtomClient(),
