@@ -326,7 +326,42 @@ def bench_north_star():
     }
 
 
+def _device_health_gate(timeout_s: float = 180.0) -> None:
+    """Fail fast with a diagnostic if the accelerator is unreachable
+    (the axon tunnel can wedge behind an orphaned server-side compile;
+    without this gate the bench hangs indefinitely instead of telling
+    the operator what's wrong). Runs the probe in a subprocess — a
+    wedged device call cannot be interrupted in-process."""
+    import subprocess
+
+    probe = (
+        "import jax, jax.numpy as jnp, numpy as np; "
+        "np.asarray(jax.jit(lambda x: x + 1)(jnp.zeros(4))); "
+        "print('healthy')"
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        if "healthy" in (p.stdout or ""):
+            return
+        tail = (p.stderr or "")[-500:]
+    except subprocess.TimeoutExpired:
+        tail = f"device probe did not answer within {timeout_s:.0f}s"
+    print(
+        "bench aborted: accelerator unreachable (wedged tunnel / "
+        f"terminal-side compile?): {tail}",
+        file=sys.stderr,
+    )
+    raise SystemExit(3)
+
+
 def main() -> None:
+    # Gate BEFORE importing jax: plugin registration itself can touch
+    # the wedged tunnel and hang the parent uninterruptibly.
+    _device_health_gate()
+
     import jax
 
     configs = [

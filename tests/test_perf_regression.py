@@ -1,0 +1,96 @@
+"""Checker perf-regression smoke (tier 3, perf_test.clj's role): every
+checker family runs over a fixed LARGISH history in one go — not
+timing assertions (flaky in CI), but the at-scale code paths the tiny
+unit histories never touch (blocked set-full reductions, device-path
+thresholds, long single-key WGL streams on the CPU oracle)."""
+
+import random
+
+from jepsen_tpu.checker.adya import G2Checker
+from jepsen_tpu.checker.bank import BankChecker
+from jepsen_tpu.checker.divergence import DirtyReadsChecker
+from jepsen_tpu.checker.linearizable import LinearizableChecker
+from jepsen_tpu.checker.longfork import LongForkChecker
+from jepsen_tpu.checker.reductions import (
+    counter,
+    set_full,
+    total_queue,
+    unique_ids,
+)
+from jepsen_tpu.runtime import run
+from jepsen_tpu.sim import (
+    gen_bank_history,
+    gen_g2_history,
+    gen_long_fork_history,
+    gen_register_history,
+)
+
+
+def test_linearizable_5k_ops_cpu():
+    h = gen_register_history(
+        random.Random(1), n_ops=5000, n_procs=5, p_crash=0.002
+    )
+    r = LinearizableChecker().check({}, h)
+    assert r["valid?"] is True, r
+    assert r["n_ops"] > 3000
+
+
+def test_bank_20k_ops():
+    test = {"accounts": list(range(8)), "total_amount": 100}
+    h = gen_bank_history(random.Random(2), n_ops=20_000)
+    r = BankChecker().check(test, h)
+    assert r["valid?"] is True and r["read_count"] > 5000
+
+
+def test_g2_20k_keys():
+    h = gen_g2_history(random.Random(3), n_keys=20_000)
+    r = G2Checker().check({}, h)
+    assert r["valid?"] is True and r["key_count"] == 20_000
+
+
+def test_long_fork_64_groups():
+    h = gen_long_fork_history(
+        random.Random(4), n_groups=64, ops_per_group=128, n=2
+    )
+    r = LongForkChecker(2).check({}, h)
+    assert r["valid?"] is True
+
+
+def test_reductions_at_scale():
+    from jepsen_tpu.workloads import counter as counter_wl
+    from jepsen_tpu.workloads import set as set_wl
+    from jepsen_tpu.suites.hazelcast import _queue_workload, IdGenClient
+    from jepsen_tpu.generator import pure as gen
+
+    # set-full over thousands of elements (the blocked reduction)
+    spec = set_wl.workload(n_adds=4000, rng=random.Random(5))
+    out = run({**spec, "concurrency": 4})
+    assert out["results"]["valid?"] is True
+
+    # counter with thousands of deltas
+    spec = counter_wl.workload(n_ops=4000, rng=random.Random(6))
+    out = run({**spec, "concurrency": 4})
+    assert out["results"]["valid?"] is True
+
+    # queue conservation over thousands of enqueues + final drain
+    spec = _queue_workload({"ops": 4000, "rng": random.Random(7)})
+    out = run({**spec, "checker": total_queue(), "concurrency": 4})
+    assert out["results"]["valid?"] is True
+
+    # unique ids at scale
+    out = run({
+        "client": IdGenClient(),
+        "generator": gen.clients(gen.limit(4000, {"f": "generate"})),
+        "checker": unique_ids(),
+        "concurrency": 4,
+    })
+    assert out["results"]["valid?"] is True
+
+
+def test_dirty_reads_at_scale():
+    from jepsen_tpu.workloads import dirty_reads
+
+    spec = dirty_reads.workload(n_ops=4000, rng=random.Random(8))
+    out = run({**spec, "concurrency": 4})
+    r = out["results"]
+    assert r["valid?"] is True and r["read_count"] > 500
