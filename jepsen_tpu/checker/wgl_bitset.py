@@ -58,7 +58,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from jepsen_tpu.checker.events import ReturnSteps, bucket
+from jepsen_tpu.checker.events import ReturnSteps, bucket, memo_on
 from jepsen_tpu.checker.models import model as get_model
 
 #: out columns: alive, taint, died op index, rounds total, rounds max
@@ -517,8 +517,13 @@ def check_steps_bitset_segmented(
     segs = plan_segments(steps)
     name = model if isinstance(model, str) else model.name
     if len(segs) == 1:
-        # Not worth multiple launches: one scan, shape-bucketed.
-        padded = steps.padded(bucket(max(len(steps), 1), 64))
+        # Not worth multiple launches: one scan, shape-bucketed. The
+        # padded object memoizes on steps so re-checks reuse its
+        # packed device args.
+        padded = memo_on(
+            steps, "_padded_single", None,
+            lambda: steps.padded(bucket(max(len(steps), 1), 64)),
+        )
         verdict = check_steps_bitset(
             padded, model=model, S=S, interpret=interpret
         )
@@ -529,13 +534,23 @@ def check_steps_bitset_segmented(
     fr = jnp.asarray(init_frontier(steps.init_state, S, segs[0][2])[None])
     outs = []
     frs = []
-    for start, end, W in segs:
+
+    def packed(start, end, W):
         sub = _slice_steps(steps, start, end, W)
         sub = sub.padded(bucket(max(len(sub), 1), 64))
         win, meta = pack_steps(sub)
+        return jnp.asarray(win[None]), jnp.asarray(meta[None])
+
+    for start, end, W in segs:
+        # per-segment packed device args memoize like _bitset_args:
+        # re-checks skip slicing/packing/upload
+        args = memo_on(
+            steps, "_seg_args", (start, end, W),
+            lambda s=start, e=end, w=W: packed(s, e, w),
+        )
         fr = _embed_frontier(fr, S, bitset_words(W))
         out, fr = _bitset_scan(
-            jnp.asarray(win[None]), jnp.asarray(meta[None]), fr,
+            *args, fr,
             model_name=name, S=S, W=W, interpret=interpret,
         )
         outs.append(out)
