@@ -213,12 +213,25 @@ def crashed_invokes(events: EventStream) -> np.ndarray:
     return out
 
 
+#: every derived-artifact cache attribute memo_on manages (cleared as
+#: a set by clear_memos)
+MEMO_ATTRS = (
+    "_steps_cache", "_seg_args", "_padded_single", "_bitset_args",
+    "_pallas_args", "_death_frontier",
+)
+
+
 def memo_on(obj, attr: str, key, factory):
     """Memoize factory() on obj under attr[key] — the one idiom for
     every derived-artifact cache in the checker plane (steps per W,
     packed device args per segment, padded singles). The contract it
     rests on: EventStream/ReturnSteps are immutable once built — every
-    driver path constructs them fresh and never mutates in place."""
+    driver path constructs them fresh and never mutates in place.
+
+    Retention note: memos pin their host arrays / device buffers for
+    the object's lifetime (a 100k-op stream's steps are tens of MB).
+    Callers holding MANY streams past their verdicts should
+    clear_memos() once checking is done."""
     cache = getattr(obj, attr, None)
     if cache is None:
         cache = {}
@@ -227,6 +240,28 @@ def memo_on(obj, attr: str, key, factory):
     if val is None:
         val = cache[key] = factory()
     return val
+
+
+def clear_memos(obj) -> None:
+    """Drop every derived-artifact memo from a stream/steps object
+    (and recursively from memoized steps), releasing the pinned host
+    and device memory."""
+    steps_cache = getattr(obj, "_steps_cache", None)
+    if isinstance(steps_cache, dict):
+        for v in steps_cache.values():
+            if v is not obj:
+                clear_memos(v)
+    padded = getattr(obj, "_padded_single", None)
+    if isinstance(padded, dict):
+        for v in padded.values():
+            if v is not obj:
+                clear_memos(v)
+    for attr in MEMO_ATTRS:
+        if hasattr(obj, attr):
+            try:
+                delattr(obj, attr)
+            except AttributeError:
+                pass
 
 
 def events_to_steps(events: EventStream, W: int) -> ReturnSteps:

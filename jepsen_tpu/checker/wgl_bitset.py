@@ -415,11 +415,11 @@ def check_steps_bitset(
     as wgl_pallas: ReturnSteps are treated as immutable once checked —
     every driver path builds them fresh via events_to_steps; mutating
     one in place after a check would replay stale device data)."""
-    args = getattr(steps, "_bitset_args", None)
-    if args is None:
+    def pack_dev():
         win, meta = pack_steps(steps)
-        args = (jnp.asarray(win[None]), jnp.asarray(meta[None]))
-        steps._bitset_args = args
+        return jnp.asarray(win[None]), jnp.asarray(meta[None])
+
+    args = memo_on(steps, "_bitset_args", None, pack_dev)
     fr0 = jnp.asarray(init_frontier(steps.init_state, S, steps.W)[None])
     out, fr = _bitset_scan(
         *args,

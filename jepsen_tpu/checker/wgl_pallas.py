@@ -367,10 +367,11 @@ def check_steps_pallas(
     escalation-ladder rungs change only K, so re-running at a bigger K
     must not re-pack or re-upload the (potentially tens of MB) step
     arrays through the host-device link."""
-    args = getattr(steps, "_pallas_args", None)
-    if args is None:
-        args = steps_pallas_args(steps)
-        steps._pallas_args = args
+    from jepsen_tpu.checker.events import memo_on
+
+    args = memo_on(
+        steps, "_pallas_args", None, lambda: steps_pallas_args(steps)
+    )
     out = _pallas_scan(
         *args,
         model_name=model if isinstance(model, str) else model.name,

@@ -124,13 +124,26 @@ def op_from_json(d: dict) -> Op:
     )
 
 
+#: ops per write chunk (util.clj:189-206 parallelizes serialization
+#: above 16,384 ops; under the GIL the Python-native equivalent is
+#: chunked join + one write syscall per chunk — C-speed json, no
+#: per-op write overhead)
+HISTORY_WRITE_CHUNK = 16_384
+
+
 def write_history_jsonl(path: str, ops: Iterable[Op]) -> None:
     """One op per JSON line — THE history file format (used by Store
-    and by per-key artifact writers)."""
+    and by per-key artifact writers). Large histories write in
+    HISTORY_WRITE_CHUNK batches."""
     with open(path, "w") as f:
+        buf = []
         for op in ops:
-            f.write(json.dumps(op_to_json(op), default=str))
-            f.write("\n")
+            buf.append(json.dumps(op_to_json(op), default=str))
+            if len(buf) >= HISTORY_WRITE_CHUNK:
+                f.write("\n".join(buf) + "\n")
+                buf.clear()
+        if buf:
+            f.write("\n".join(buf) + "\n")
 
 
 def write_results_json(path: str, results: Any) -> None:
