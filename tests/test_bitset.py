@@ -186,6 +186,25 @@ def test_batch_matches_single():
         assert alive == check_events(s)
 
 
+def test_steps_memoization_and_clear():
+    """events_to_steps memoizes per (stream, W); clear_memos releases
+    every derived artifact so the next check rebuilds from scratch."""
+    from jepsen_tpu.checker.events import clear_memos
+
+    h = gen_register_history(random.Random(0), n_ops=40, n_procs=3)
+    ev = history_to_events(h)
+    s1 = events_to_steps(ev, W=16)
+    assert events_to_steps(ev, W=16) is s1
+    s12 = events_to_steps(ev, W=12)
+    assert s12 is not s1
+    # memos attached during a check clear recursively
+    _check(ev)
+    clear_memos(ev)
+    assert not hasattr(ev, "_steps_cache")
+    s2 = events_to_steps(ev, W=16)
+    assert s2 is not s1
+
+
 def test_wide_window_routes_out():
     assert w_bucket(17) is None or w_bucket(17) >= 17
     assert w_bucket(200) is None
