@@ -23,6 +23,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from jepsen_tpu.checker.core import UNKNOWN, merge_valid
+
 #: seconds of slack past epsilon before a run counts as missed
 #: (checker.clj epsilon-forgiveness)
 EPSILON_FORGIVENESS = 5
@@ -47,10 +49,19 @@ def job_solution(
     Runs are {start, end?}; only completed runs (with an end) satisfy
     targets."""
     window = job["epsilon"] + EPSILON_FORGIVENESS
-    assert window < job["interval"], (
-        "targets must be disjoint (epsilon + forgiveness < interval); "
-        f"got window {window} >= interval {job['interval']}"
-    )
+    if window >= job["interval"]:
+        # Overlapping targets need the reference's constraint solver
+        # (loco, checker.clj:116-170); rather than crash the whole
+        # analysis on one odd job config, degrade that job to unknown.
+        return {
+            "valid?": UNKNOWN,
+            "job": job,
+            "error": (
+                "targets overlap (epsilon + forgiveness "
+                f"{window} >= interval {job['interval']}); "
+                "disjoint-target fast path cannot decide this job"
+            ),
+        }
     targets = job_targets(job, read_time)
     complete = np.asarray(
         sorted(r["start"] for r in runs if r.get("end") is not None),
@@ -131,7 +142,7 @@ class ScheduleChecker:
                     else None
                 )
         if final_read is None:
-            return {"valid?": "unknown", "error": "jobs were never read"}
+            return {"valid?": UNKNOWN, "error": "jobs were never read"}
         runs = (
             final_read.get("runs")
             if isinstance(final_read, dict)
@@ -149,7 +160,9 @@ class ScheduleChecker:
             for name, job in jobs.items()
         }
         return {
-            "valid?": all(s["valid?"] for s in solutions.values()),
+            "valid?": merge_valid(
+                s["valid?"] for s in solutions.values()
+            ),
             "job_count": len(jobs),
             "run_count": len(runs),
             "jobs": solutions,

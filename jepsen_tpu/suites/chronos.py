@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import json
 import random
+import time
+from datetime import datetime, timezone
 from typing import Any, Dict, List, Optional
 
 from jepsen_tpu import net as netlib, nemesis as nemlib
@@ -94,8 +96,25 @@ class ChronosRestClient(Client):
         sess = sessions_for(test)[self.node]
         try:
             if op.f == "add-job":
-                job = op.value
+                # The generator emits starts as offsets on a simulated
+                # grid; against a real cluster the logged runs are
+                # wall-clock epoch seconds, so anchor the job's start
+                # to the control host's clock here and emit it in the
+                # ISO8601 schedule. The anchored job rides the ok op
+                # back into the history, so the checker's target grid
+                # and the run log share one time base.
+                job = dict(op.value)
                 name = str(job["name"])
+                # Floor to whole seconds: the ISO8601 schedule below
+                # and the run log's `date +%s` are both second-grained,
+                # and a fractional anchor would skew the checker's
+                # bucket grid by up to ~1s against the actual runs.
+                job["start"] = float(int(
+                    time.time() + float(job.get("start", 0.0))
+                ))
+                iso_start = datetime.fromtimestamp(
+                    job["start"], timezone.utc
+                ).strftime("%Y-%m-%dT%H:%M:%SZ")
                 # Each run logs "<name> <start>" when it begins and
                 # "<name> <start> <end>" when it completes — the shape
                 # the read parser and the checker's incomplete-run
@@ -108,9 +127,10 @@ class ChronosRestClient(Client):
                 spec = {
                     "name": name,
                     "schedule": (
-                        f"R{job['count']}//PT{job['interval']}S"
+                        f"R{job['count']}/{iso_start}/"
+                        f"PT{job['interval']:g}S"
                     ),
-                    "epsilon": f"PT{job['epsilon']}S",
+                    "epsilon": f"PT{job['epsilon']:g}S",
                     "command": cmd,
                 }
                 sess.exec(
@@ -119,7 +139,7 @@ class ChronosRestClient(Client):
                     "-d", json.dumps(spec),
                     f"http://{self.node}:4400/scheduler/iso8601",
                 )
-                return op.with_(type="ok")
+                return op.with_(type="ok", value=job)
             if op.f == "advance-clock":
                 return op.with_(type="ok")  # real time advances itself
             if op.f == "read":
@@ -141,11 +161,9 @@ class ChronosRestClient(Client):
                     {"name": n, "start": s, "end": done.get((n, s))}
                     for (n, s) in begun
                 ]
-                import time as _t
-
                 return op.with_(
                     type="ok",
-                    value={"time": _t.time(), "runs": runs},
+                    value={"time": time.time(), "runs": runs},
                 )
             raise ValueError(f"unknown op f={op.f!r}")
         except ValueError:

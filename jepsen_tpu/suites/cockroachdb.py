@@ -217,17 +217,21 @@ class SqlBankClient(CockroachSqlClient):
                 # One guarded statement (Postgres dialect — cockroach
                 # has no ROW_COUNT()): debit and credit apply together
                 # or not at all, so an insufficient balance can't mint
-                # money on the credit side.
-                self._sql(
+                # money on the credit side. RETURNING exposes whether
+                # the guard matched: zero rows back means the transfer
+                # never applied, which must surface as :fail, not :ok
+                # (ref marks insufficient-balance transfers :fail).
+                out = self._sql(
                     test,
                     "UPDATE accounts SET balance = CASE "
                     f"WHEN id = {frm} THEN balance - {amt} "
                     f"ELSE balance + {amt} END "
                     f"WHERE id IN ({frm}, {to}) AND "
                     f"(SELECT balance FROM accounts WHERE id = {frm}) "
-                    f">= {amt};",
+                    f">= {amt} RETURNING id;",
                 )
-                return op.with_(type="ok")
+                applied = bool(self._rows(out))
+                return op.with_(type="ok" if applied else "fail")
             raise ValueError(f"unknown op f={op.f!r}")
         except ValueError:
             raise

@@ -122,15 +122,25 @@ class GaleraBankClient(Client):
                 amt, frm, to = (
                     int(v["amount"]), int(v["from"]), int(v["to"])
                 )
-                self._sql(
+                # SELECT ROW_COUNT() after the guarded credit reports
+                # whether the second UPDATE applied; an insufficient
+                # balance leaves both rows untouched and must return
+                # :fail rather than record a phantom acked transfer.
+                out = self._sql(
                     test,
                     "BEGIN; "
                     f"UPDATE accounts SET balance = balance - {amt} "
                     f"WHERE id = {frm} AND balance >= {amt}; "
                     f"UPDATE accounts SET balance = balance + {amt} "
-                    f"WHERE id = {to} AND ROW_COUNT() > 0; COMMIT;",
+                    f"WHERE id = {to} AND ROW_COUNT() > 0; "
+                    "SELECT ROW_COUNT(); COMMIT;",
                 )
-                return op.with_(type="ok")
+                lines = [
+                    ln.strip() for ln in out.splitlines() if ln.strip()
+                ]
+                applied = bool(lines) and lines[-1].isdigit() \
+                    and int(lines[-1]) > 0
+                return op.with_(type="ok" if applied else "fail")
             raise ValueError(f"unknown op f={op.f!r}")
         except ValueError:
             raise

@@ -254,3 +254,48 @@ def test_sql_register_client_command_shapes():
     # cas with no returned row -> fail
     out = c.invoke(test, invoke_op(0, "cas", independent.KV(4, [0, 1])))
     assert out.type == "fail"
+
+
+def test_sql_bank_transfer_zero_row_is_fail():
+    """An unapplied (insufficient-balance) transfer must come back
+    :fail, not :ok — the guard's RETURNING clause exposes the zero-row
+    case (ref marks insufficient-balance transfers :fail)."""
+    remote = DummyRemote()  # empty stdout: RETURNING matched no rows
+    test = {"nodes": ["n1"], "remote": remote}
+    c = cr.SqlBankClient().open(test, "n1")
+    out = c.invoke(
+        test, invoke_op(0, "transfer", {"from": 0, "to": 1, "amount": 5})
+    )
+    assert out.type == "fail"
+    assert any(
+        "RETURNING id" in c2 for c2 in remote.commands("n1")
+    )
+
+    # With a row back from RETURNING, the transfer is acked.
+    remote = DummyRemote({"RETURNING id": (0, "id\n0\n", "")})
+    test = {"nodes": ["n1"], "remote": remote}
+    c = cr.SqlBankClient().open(test, "n1")
+    out = c.invoke(
+        test, invoke_op(0, "transfer", {"from": 0, "to": 1, "amount": 5})
+    )
+    assert out.type == "ok"
+
+
+def test_galera_bank_transfer_zero_row_is_fail():
+    from jepsen_tpu.suites import galera
+
+    remote = DummyRemote({"SELECT ROW_COUNT()": (0, "ROW_COUNT()\n0\n", "")})
+    test = {"nodes": ["n1"], "remote": remote}
+    c = galera.GaleraBankClient().open(test, "n1")
+    out = c.invoke(
+        test, invoke_op(0, "transfer", {"from": 0, "to": 1, "amount": 5})
+    )
+    assert out.type == "fail"
+
+    remote = DummyRemote({"SELECT ROW_COUNT()": (0, "ROW_COUNT()\n1\n", "")})
+    test = {"nodes": ["n1"], "remote": remote}
+    c = galera.GaleraBankClient().open(test, "n1")
+    out = c.invoke(
+        test, invoke_op(0, "transfer", {"from": 0, "to": 1, "amount": 5})
+    )
+    assert out.type == "ok"

@@ -264,11 +264,45 @@ def test_chronos_db_and_rest_client_commands():
     assert any("chronos" in c and "--zk_hosts" in c for c in cmds)
 
     c = chronos.ChronosRestClient().open(test, "n1")
-    job = {"name": "j1", "start": 0.0, "interval": 60.0, "count": 3,
+    job = {"name": "j1", "start": 5.0, "interval": 60.0, "count": 3,
            "epsilon": 10.0, "duration": 2.0}
+    import time as _time
+
+    before = _time.time()
     out = c.invoke(test, inv(0, "add-job", job))
+    after = _time.time()
     assert out.type == "ok"
+    # The schedule carries an explicit ISO8601 start (R3/<start>/PT60S)
+    # and the ok op's job is anchored to the control host's wall clock
+    # plus the generator's relative offset — the run log's time base.
     assert any(
-        "scheduler/iso8601" in c2 and "R3//PT60" in c2.replace(".0", "")
+        "scheduler/iso8601" in c2 and "R3/2" in c2 and "/PT60S" in c2
         for c2 in remote.commands("n1")
     )
+    # Anchored to the wall clock + offset, floored to whole seconds to
+    # match the second-grained ISO schedule and `date +%s` run log.
+    start = out.value["start"]
+    assert start == float(int(start))
+    assert before + 4.0 <= start <= after + 5.0
+    # Original generator-side job map is not mutated in place.
+    assert job["start"] == 5.0
+
+
+def test_job_solution_overlapping_targets_degrades_to_unknown():
+    """Overlapping targets (epsilon + forgiveness >= interval) need the
+    reference's constraint solver; the fast path must degrade that job
+    to unknown instead of crashing the whole analysis."""
+    job = {"name": "j", "start": 0.0, "interval": 10.0, "count": 4,
+           "epsilon": 10.0, "duration": 1.0}
+    r = job_solution(job, 170.0, [{"start": 2.0, "end": 3.0}])
+    assert r["valid?"] == "unknown" and "overlap" in r["error"]
+
+    # And through the checker: one odd job -> overall unknown (lattice),
+    # not an exception; a failing job still dominates to False.
+    h = History([
+        invoke_op(0, "add-job"),
+        ok_op(0, "add-job", job),
+        invoke_op(0, "read"),
+        ok_op(0, "read", {"time": 170.0, "runs": []}),
+    ])
+    assert ScheduleChecker().check({}, h)["valid?"] == "unknown"
