@@ -39,7 +39,7 @@ from jepsen_tpu.checker.events import (
     events_to_steps,
     history_to_events,
 )
-from jepsen_tpu.checker.wgl_oracle import check_events as oracle_check
+from jepsen_tpu.checker.wgl_oracle import check_events_fast as oracle_check_fast
 from jepsen_tpu.checker.wgl_jax import check_steps_jax
 
 #: K escalation ladder: frontier capacities tried in order. Starts at
@@ -113,7 +113,7 @@ def check_events_bucketed(
     """Definite linearizability verdict for an event stream.
 
     Returns {"valid?": bool, "method": "tpu-wgl-bitset"|"tpu-wgl"|
-             "cpu-oracle", "frontier_k": K or None, "escalations": int}.
+             "cpu-oracle-native"|"cpu-oracle-python", "frontier_k": K or None, "escalations": int}.
     """
     from jepsen_tpu.checker.models import model as get_model
 
@@ -179,10 +179,12 @@ def check_events_bucketed(
             if W is None
             else f"model {m.name} is host-only (rich state)"
         )
-        valid, stats = oracle_check(events, model=model, return_stats=True)
+        valid, stats = oracle_check_fast(
+            events, model=model, return_stats=True
+        )
         out = {
             "valid?": valid,
-            "method": "cpu-oracle",
+            "method": f"cpu-oracle-{stats['oracle']}",
             "frontier_k": None,
             "escalations": 0,
             "reason": reason,
@@ -251,10 +253,12 @@ def check_events_bucketed(
                 out["failed_op_index"] = died
             return out
         escalations += 1
-    valid, stats = oracle_check(events, model=model, return_stats=True)
+    valid, stats = oracle_check_fast(
+        events, model=model, return_stats=True
+    )
     out = {
         "valid?": valid,
-        "method": "cpu-oracle",
+        "method": f"cpu-oracle-{stats['oracle']}",
         "frontier_k": None,
         "escalations": escalations,
         "reason": f"frontier overflowed at K={k_ladder[-1]}",
@@ -301,10 +305,12 @@ class LinearizableChecker:
                 init_value=self.init_value,
                 max_window=1 << 20,
             )
-            valid = oracle_check(events, model=self.model)
+            valid, stats = oracle_check_fast(
+                events, model=self.model, return_stats=True
+            )
             return {
                 "valid?": valid,
-                "method": "cpu-oracle",
+                "method": f"cpu-oracle-{stats['oracle']}",
                 "n_ops": events.n_ops,
                 "wall_s": time.perf_counter() - t0,
             }
@@ -312,9 +318,12 @@ class LinearizableChecker:
         if self.use_tpu:
             out = check_events_bucketed(events, model=self.model)
         else:
+            valid, stats = oracle_check_fast(
+                events, model=self.model, return_stats=True
+            )
             out = {
-                "valid?": oracle_check(events, model=self.model),
-                "method": "cpu-oracle",
+                "valid?": valid,
+                "method": f"cpu-oracle-{stats['oracle']}",
             }
         out["n_ops"] = events.n_ops
         out["window"] = events.window

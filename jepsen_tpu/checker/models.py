@@ -29,7 +29,10 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional, Tuple
 
-import jax.numpy as jnp
+# jax is imported lazily inside the *_jax step functions (they only run
+# under jit tracing): the CPU oracle's import chain — including spawned
+# bounded-pmap workers, which must never touch an ambient TPU plugin —
+# stays jax-free.
 
 NIL = -1
 
@@ -61,6 +64,8 @@ def cas_register_step_py(state: int, f: int, a: int, b: int) -> Tuple[bool, int]
 
 
 def cas_register_step_jax(state, f, a, b):
+    import jax.numpy as jnp
+
     # Pure boolean algebra + where on ints only: keeps the function
     # Mosaic-lowerable inside the Pallas megakernel as well as jittable.
     is_read = f == F_READ
@@ -81,6 +86,8 @@ def register_step_py(state: int, f: int, a: int, b: int) -> Tuple[bool, int]:
 
 
 def register_step_jax(state, f, a, b):
+    import jax.numpy as jnp
+
     is_read = f == F_READ
     is_write = f == F_WRITE
     ok = is_write | (is_read & (state == a))
@@ -106,6 +113,8 @@ def register_step_jax(state, f, a, b):
 
 
 def cas_register_bitset_slot(f, a, b):
+    import jax.numpy as jnp
+
     is_write = f == F_WRITE
     is_cas = f == F_CAS
     dst = jnp.where(is_cas, b, a) + 1
@@ -113,6 +122,8 @@ def cas_register_bitset_slot(f, a, b):
 
 
 def register_bitset_slot(f, a, b):
+    import jax.numpy as jnp
+
     is_write = f == F_WRITE
     return is_write, a + 1, a + 1, f != F_CAS
 
@@ -198,6 +209,8 @@ def mutex_step_py(state: int, f: int, a: int, b: int) -> Tuple[bool, int]:
 
 
 def mutex_step_jax(state, f, a, b):
+    import jax.numpy as jnp
+
     is_acq = f == F_ACQUIRE
     ok = (is_acq & (state == 0)) | (~is_acq & (state == 1))
     # state*0 keeps the frontier axis in the output shape (the kernels
@@ -207,6 +220,8 @@ def mutex_step_jax(state, f, a, b):
 
 
 def mutex_bitset_slot(f, a, b):
+    import jax.numpy as jnp
+
     is_acq = f == F_ACQUIRE
     src = jnp.where(is_acq, 0, 1) + 1
     dst = jnp.where(is_acq, 1, 0) + 1

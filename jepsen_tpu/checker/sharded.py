@@ -32,7 +32,6 @@ from jepsen_tpu.checker.linearizable import (
     check_events_bucketed,
 )
 from jepsen_tpu.checker.wgl_jax import wgl_scan_steps
-from jepsen_tpu.checker.wgl_oracle import check_events as oracle_check
 
 try:  # JAX >= 0.4.35 exposes shard_map at top level
     from jax import shard_map as _shard_map
@@ -171,10 +170,14 @@ def check_keys(
     window = max(max(s.window for s in streams), 1)
     W = _bucket_window(window)
     if W is None:
-        # Too concurrent for the kernel: oracle everything.
+        # Too concurrent for the kernel: oracle everything, fanned out
+        # across host cores (the bounded-pmap analog).
+        from jepsen_tpu.checker.wgl_oracle import check_streams
+
+        verdicts, meta = check_streams(streams, model=model)
         return [
-            {"valid?": oracle_check(s, model=model), "method": "cpu-oracle"}
-            for s in streams
+            {"valid?": v, "method": f"cpu-oracle-{rung}"}
+            for v, rung in zip(verdicts, meta["rungs"])
         ]
     if mesh is not None:
         n_dev = int(np.prod([mesh.shape[ax] for ax in mesh.axis_names]))
@@ -248,9 +251,16 @@ def check_keys(
                             )
                         )
                     else:  # no bigger rung: the oracle decides
+                        from jepsen_tpu.checker.wgl_oracle import (
+                            check_events_fast,
+                        )
+
+                        v, st = check_events_fast(
+                            s, model=model, return_stats=True
+                        )
                         out.append({
-                            "valid?": oracle_check(s, model=model),
-                            "method": "cpu-oracle",
+                            "valid?": v,
+                            "method": f"cpu-oracle-{st['oracle']}",
                         })
             return out
         cols = stack_streams(streams, W=W, n_keys=n_keys)

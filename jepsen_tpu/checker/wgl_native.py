@@ -1,0 +1,110 @@
+"""ctypes driver for the native (C++) WGL oracle rung.
+
+``check_events_native`` runs the same set-based frontier search as
+``wgl_oracle.check_events`` at C++ speed — the knossos.wgl role
+(jepsen/src/jepsen/checker.clj:127-158) on a fast runtime. It sits
+between the TPU engines and the Python oracle in the escalation ladder,
+and doubles as the bench's strong CPU baseline (BASELINE.md's "32-core
+knossos.wgl" comparison point: knossos's wgl search is sequential per
+key, so a single-core C++ run bounds what a JVM core can do; multi-key
+parallelism is handled separately by ``wgl_oracle.check_streams``).
+
+Scope: register-family + mutex models, windows <= 64 slots. Outside
+that envelope the functions return None and callers fall back to the
+Python oracle (unbounded masks, arbitrary hashable state).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Any, Optional, Tuple, Union
+
+import numpy as np
+
+from jepsen_tpu.checker.events import EventStream, crashed_invokes
+from jepsen_tpu.checker.models import Model, model as get_model
+from jepsen_tpu.utils.cc import build_shared
+
+_MODEL_IDS = {"cas-register": 0, "register": 1, "mutex": 2}
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "resources", "wgl_native.cc",
+)
+
+_lib: Any = None
+_lib_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    so = build_shared(_SRC, "wgl_native")
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.wgl_native_check.restype = ctypes.c_longlong
+    lib.wgl_native_check.argtypes = [
+        i32p, i32p, i32p, i32p, i32p,
+        ctypes.c_void_p,  # crashed_inv (uint8*) or NULL
+        ctypes.c_longlong, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_longlong),  # out_stats[2] or NULL
+    ]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def check_events_native(
+    events: EventStream,
+    model: Any = "cas-register",
+    return_stats: bool = False,
+    prune: bool = True,
+) -> Union[None, bool, Tuple[bool, dict]]:
+    """Native-oracle verdict, or None when outside the native envelope
+    (window > 64, rich-state model, or no C++ toolchain)."""
+    m: Model = get_model(model)
+    model_id = _MODEL_IDS.get(m.name)
+    if model_id is None or events.window > 64:
+        return None
+    lib = _load()
+    if lib is None:
+        return None
+
+    c = lambda arr: np.ascontiguousarray(arr, np.int32)  # noqa: E731
+    crashed = None
+    crashed_ptr = None
+    if prune:
+        crashed = np.ascontiguousarray(
+            crashed_invokes(events).astype(np.uint8)
+        )
+        crashed_ptr = crashed.ctypes.data_as(ctypes.c_void_p)
+    stats = (ctypes.c_longlong * 2)()
+    rc = lib.wgl_native_check(
+        c(events.kind), c(events.slot), c(events.f), c(events.a),
+        c(events.b), crashed_ptr, len(events),
+        int(m.initial(events.init_state)), model_id, events.window,
+        stats,
+    )
+    if rc < 0:
+        return None
+    valid = bool(rc)
+    if not return_stats:
+        return valid
+    failed_at = int(stats[1])
+    op_idx = None
+    if failed_at >= 0 and events.op_index is not None:
+        op_idx = int(events.op_index[failed_at])
+    return valid, {
+        "max_frontier": int(stats[0]),
+        "failed_at": None if failed_at < 0 else failed_at,
+        "failed_op_index": op_idx,
+    }

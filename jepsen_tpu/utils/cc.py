@@ -1,0 +1,99 @@
+"""Local native-code build helper.
+
+The control-plane tools (nemesis_time.py, faultfs.py) compile C++ on
+the *remote node* — the reference's build-on-node discipline
+(jepsen/src/jepsen/nemesis/time.clj:14-52). This module is the *local*
+analog for host-side native components (the C++ WGL oracle, the FUSE
+fault filesystem): compile once into a content-addressed cache under
+``~/.cache/jepsen_tpu/native`` and reuse across processes/rounds.
+
+Returns None rather than raising when no toolchain is available, so
+every native component degrades to its pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import List, Optional
+
+CACHE_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "jepsen_tpu", "native"
+)
+
+
+def build_shared(
+    src_path: str,
+    name: str,
+    extra_flags: Optional[List[str]] = None,
+    cache_dir: Optional[str] = None,
+) -> Optional[str]:
+    """Compile ``src_path`` to a shared library, content-addressed by
+    source + flags. Returns the .so path, or None when g++ is missing
+    or the compile fails (callers fall back to Python)."""
+    return _build(
+        src_path, name, ["-shared", "-fPIC", *(extra_flags or [])],
+        ".so", cache_dir,
+    )
+
+
+def build_exe(
+    src_path: str,
+    name: str,
+    extra_flags: Optional[List[str]] = None,
+    cache_dir: Optional[str] = None,
+) -> Optional[str]:
+    """Compile ``src_path`` to an executable (same cache discipline)."""
+    return _build(src_path, name, list(extra_flags or []), "", cache_dir)
+
+
+def _build(
+    src_path: str,
+    name: str,
+    flags: List[str],
+    suffix: str,
+    cache_dir: Optional[str] = None,
+) -> Optional[str]:
+    extra_flags = flags
+    try:
+        with open(src_path, "rb") as fh:
+            src = fh.read()
+    except OSError:
+        return None
+    tag = hashlib.sha256(
+        src + "\0".join(extra_flags).encode()
+    ).hexdigest()[:16]
+    out_dir = cache_dir or CACHE_DIR
+    out = os.path.join(out_dir, f"{name}-{tag}{suffix}")
+    if os.path.exists(out):
+        return out
+    os.makedirs(out_dir, exist_ok=True)
+    # Build into a temp file then rename: concurrent builders (test
+    # workers) race benignly — rename is atomic on the same filesystem.
+    fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=suffix or ".bin")
+    os.close(fd)
+    cmd = [
+        "g++", "-O3", "-std=c++17",
+        "-o", tmp, src_path, *extra_flags,
+    ]
+    os.chmod(tmp, 0o755)
+    try:
+        p = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=240
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    if p.returncode != 0:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    os.replace(tmp, out)
+    return out

@@ -262,7 +262,7 @@ def test_checker_protocol_adapter():
     out = LinearizableChecker().check({}, h)
     assert out["valid?"] is True
     assert out["n_ops"] == 2
-    assert out["method"] in ("tpu-wgl", "cpu-oracle")
+    assert out["method"].startswith(("tpu-wgl", "cpu-oracle"))
 
 
 def test_small_frontier_escalation_still_definite():
@@ -399,7 +399,7 @@ def test_unordered_queue_model():
     ev = history_to_events(ok, model="unordered-queue")
     r = check_events_bucketed(ev, model="unordered-queue")
     assert r["valid?"] is True
-    assert r["method"] == "cpu-oracle"  # rich state: host-only
+    assert r["method"].startswith("cpu-oracle")  # rich state: host-only
     # dequeue of a value never enqueued: invalid
     bad = H(
         invoke_op(0, "enqueue", 1),

@@ -1,0 +1,137 @@
+"""Native (C++) WGL oracle: parity with the Python oracle, stats,
+envelope fallback, and the bounded-pmap stream fan-out.
+
+The native rung must be verdict-interchangeable with wgl_oracle
+.check_events on every history inside its envelope — it is both an
+escalation rung in the product ladder and the bench's strong CPU
+baseline, so any divergence would poison verdicts AND numbers.
+"""
+
+import random
+
+import pytest
+
+from jepsen_tpu.checker.events import history_to_events
+from jepsen_tpu.checker import wgl_native
+from jepsen_tpu.checker.wgl_oracle import (
+    check_events,
+    check_events_fast,
+    check_streams,
+)
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.ops import info_op, invoke_op, ok_op
+from jepsen_tpu.sim import corrupt_history, gen_register_history
+
+pytestmark = pytest.mark.skipif(
+    not wgl_native.available(), reason="no C++ toolchain"
+)
+
+
+def test_native_matches_python_oracle():
+    n_invalid = 0
+    for seed in range(80):
+        rng = random.Random(7000 + seed)
+        h = gen_register_history(
+            rng, n_ops=40, n_procs=4, p_crash=0.1
+        )
+        if seed % 2:
+            h = corrupt_history(h, rng)
+        ev = history_to_events(h)
+        want = check_events(ev)
+        got = wgl_native.check_events_native(ev)
+        assert got == want, f"seed {seed}: native={got} python={want}"
+        if not want:
+            n_invalid += 1
+    assert n_invalid > 10
+
+
+def test_native_stats_match_python_failed_at():
+    # On invalid histories the native failing-event position and op
+    # index must agree with the Python oracle's (the failure artifact
+    # builds on them).
+    n_checked = 0
+    for seed in range(60):
+        rng = random.Random(8000 + seed)
+        h = corrupt_history(
+            gen_register_history(rng, n_ops=30, n_procs=4), rng
+        )
+        ev = history_to_events(h)
+        want, wstats = check_events(ev, return_stats=True)
+        got, gstats = wgl_native.check_events_native(
+            ev, return_stats=True
+        )
+        assert got == want
+        if not want:
+            assert gstats["failed_at"] == wstats["failed_at"]
+            assert (
+                gstats["failed_op_index"] == wstats["failed_op_index"]
+            )
+            n_checked += 1
+    assert n_checked > 5
+
+
+def test_native_mutex_parity():
+    ok_h = History([
+        invoke_op(0, "acquire"), ok_op(0, "acquire"),
+        invoke_op(0, "release"), ok_op(0, "release"),
+        invoke_op(1, "acquire"), ok_op(1, "acquire"),
+    ])
+    bad = History([
+        invoke_op(0, "acquire"), ok_op(0, "acquire"),
+        invoke_op(1, "acquire"), ok_op(1, "acquire"),
+    ])
+    for h, want in ((ok_h, True), (bad, False)):
+        ev = history_to_events(h, model="mutex")
+        assert check_events(ev, model="mutex") is want
+        assert wgl_native.check_events_native(ev, model="mutex") is want
+
+
+def test_native_declines_outside_envelope():
+    # window > 64: the int64-mask native search cannot represent it.
+    ops = []
+    for p in range(70):
+        ops.append(invoke_op(p, "write", p))
+        ops.append(info_op(p, "write", p))  # crashed: slot never freed
+    ops.append(invoke_op(200, "read"))
+    ops.append(ok_op(200, "read", 3))
+    ev = history_to_events(History(ops), max_window=1 << 10)
+    assert ev.window > 64
+    assert wgl_native.check_events_native(ev) is None
+    # ...and the fast dispatcher falls back to Python transparently.
+    valid, stats = check_events_fast(ev, return_stats=True)
+    assert stats["oracle"] == "python"
+    assert valid == check_events(ev)
+
+
+def test_native_prune_off_parity():
+    for seed in range(20):
+        rng = random.Random(9000 + seed)
+        h = gen_register_history(
+            rng, n_ops=16, n_procs=3, p_crash=0.25
+        )
+        if seed % 2:
+            h = corrupt_history(h, rng)
+        ev = history_to_events(h)
+        assert wgl_native.check_events_native(
+            ev, prune=False
+        ) == check_events(ev, prune=False), f"seed {seed}"
+
+
+def test_check_streams_matches_serial():
+    streams = []
+    wants = []
+    for seed in range(10):
+        rng = random.Random(500 + seed)
+        h = gen_register_history(rng, n_ops=60, n_procs=4)
+        if seed % 3 == 0:
+            h = corrupt_history(h, rng)
+        ev = history_to_events(h)
+        streams.append(ev)
+        wants.append(check_events(ev))
+    got, meta = check_streams(streams)
+    assert got == wants
+    assert meta["processes"] >= 1 and meta["host_cores"] >= 1
+    # Forced multi-process path must agree too (pool of 2 even on a
+    # 1-core host exercises the fork/pickle plumbing).
+    got2, meta2 = check_streams(streams, processes=2)
+    assert got2 == wants
