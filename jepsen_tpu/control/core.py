@@ -20,6 +20,7 @@ backend), DummyRemote records commands and returns canned results
 
 from __future__ import annotations
 
+import os
 import shlex
 import subprocess
 import threading
@@ -96,6 +97,10 @@ class LocalRemote(Remote):
         return LocalRemote(node)
 
     def execute(self, cmd, sudo=False, cd=None, stdin=None):
+        # Already-root hosts (containers) often lack a sudo binary;
+        # the escalation is a no-op there, so elide it.
+        if sudo and os.geteuid() == 0:
+            sudo = False
         p = subprocess.run(
             ["sh", "-c", _wrap(cmd, sudo, cd)],
             capture_output=True,

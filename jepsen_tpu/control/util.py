@@ -80,8 +80,30 @@ def grepkill(session: Session, pattern: str, signal: str = "KILL") -> None:
 
 def signal_proc(session: Session, process: str, signal: str) -> None:
     """Send a signal by process name — SIGSTOP/SIGCONT for pause
-    nemeses (signal!, control/util.clj:266-270)."""
-    session.exec("killall", "-s", signal, process, sudo=True)
+    nemeses (signal!, control/util.clj:266-270). killall (psmisc) with
+    a pkill fallback: minimal images often ship procps only."""
+    import shlex
+
+    # The cmdline fallback covers interpreter-run daemons (python/
+    # java), whose program name lives in argv, not comm. It must NOT
+    # use bare `pkill -f`: the pattern appears inside this very shell
+    # wrapper's cmdline, and a self-SIGSTOP wedges the control session
+    # forever. Instead, walk pgrep's candidates and signal only
+    # non-shell processes (the daemon's comm is its interpreter).
+    sig = shlex.quote(str(signal))
+    proc = shlex.quote(process)
+    fallback = (
+        f'for p in $(pgrep -f {proc}); do '
+        f'c=$(cat /proc/$p/comm 2>/dev/null); '
+        f'case "$c" in sh|bash|dash|sudo|pgrep|pkill|killall) ;; '
+        f'*) kill -{sig} $p ;; esac; done'
+    )
+    session.exec(
+        "sh", "-c",
+        f"killall -s {sig} {proc} 2>/dev/null || "
+        f"pkill -{sig} -x {proc} 2>/dev/null || {{ {fallback}; }}",
+        sudo=True,
+    )
 
 
 def install_archive(

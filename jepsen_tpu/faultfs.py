@@ -1,14 +1,23 @@
-"""Disk-fault injection driver: deploy and control the faultfs shim.
+"""Disk-fault injection drivers: mount-level FUSE and LD_PRELOAD shim.
 
 Reference: charybdefs/src/jepsen/charybdefs.clj — build the fault
 filesystem on the node (:7-65) and flip faults at runtime: every op
-EIO (:67-72), a percentage of ops (:74-79), clear (:81-85). Here the
-native component is resources/faultfs.cc, an LD_PRELOAD interposer (see
-its header for why that beats a FUSE mount in the container era, and
-its scope note: libc-dynamic databases only — statically-linked Go
-binaries need kernel-level fault injection); the DB under test starts
-with `env_for(...)` in its daemon environment, and the nemesis mutates
-the per-node config file over the control plane.
+EIO (:67-72), a percentage of ops (:74-79), clear (:81-85).
+
+Two native backends, both built on-node from resources/:
+
+1. **fusefaultfs.cc — the primary, charybdefs-parity backend.** A
+   raw-protocol FUSE passthrough mounted over the data directory
+   (install_fuse + FuseFaultFSNemesis). Because the interception is at
+   the VFS mount, it afflicts ANY process — including statically-linked
+   Go binaries (etcd, consul) that no userspace interposer can touch.
+   Runtime control is the `.faultfs-ctl` file at the mount root (the
+   Thrift-server role in charybdefs, with no RPC stack to install).
+2. **faultfs.cc — LD_PRELOAD interposer fallback** for environments
+   where FUSE mounts are unavailable (no /dev/fuse in the container,
+   no CAP_SYS_ADMIN): libc-dynamic databases only; the DB starts with
+   `env_for(...)` in its daemon environment and the nemesis mutates
+   the per-node config file over the control plane.
 """
 
 from __future__ import annotations
@@ -73,6 +82,28 @@ def write_config(
     )
 
 
+def _dispatch_per_node(test, op: Op, fn) -> Op:
+    """Shared nemesis dispatch: the op value is a scalar applied to
+    all nodes, or {node: scalar} applying each node its own spec;
+    ``fn(node, session, value)`` runs per targeted node and its
+    results become the info op's value."""
+    value = op.value
+    if isinstance(value, dict) and value and all(
+        n in test["nodes"] for n in value
+    ):
+        per_node = dict(value)
+    else:
+        per_node = {n: value for n in test["nodes"]}
+    return op.with_(
+        type="info",
+        value=on_nodes(
+            test,
+            lambda node, sess: fn(node, sess, per_node[node]),
+            list(per_node),
+        ),
+    )
+
+
 class FaultFSNemesis(Nemesis):
     """f-routed disk faults (charybdefs.clj:67-85):
 
@@ -97,16 +128,6 @@ class FaultFSNemesis(Nemesis):
         return self
 
     def invoke(self, test, op: Op) -> Op:
-        # Op value: a scalar applied to all nodes, or {node: scalar}
-        # applying each node its OWN spec.
-        value = op.value
-        if isinstance(value, dict) and value and all(
-            n in test["nodes"] for n in value
-        ):
-            per_node = dict(value)
-        else:
-            per_node = {n: value for n in test["nodes"]}
-
         def kw_for(v) -> dict:
             if op.f == "start":
                 return {"mode": "fail"}
@@ -120,14 +141,12 @@ class FaultFSNemesis(Nemesis):
                 return {"mode": "none"}
             raise ValueError(f"faultfs nemesis can't handle f={op.f!r}")
 
-        def fn(node, sess):
-            kw = kw_for(per_node[node])
+        def fn(node, sess, v):
+            kw = kw_for(v)
             write_config(sess, self.prefix, **kw)
             return kw["mode"]
 
-        return op.with_(
-            type="info", value=on_nodes(test, fn, list(per_node))
-        )
+        return _dispatch_per_node(test, op, fn)
 
     def teardown(self, test) -> None:
         try:
@@ -143,3 +162,130 @@ class FaultFSNemesis(Nemesis):
 
 def faultfs_nemesis(prefix: str) -> FaultFSNemesis:
     return FaultFSNemesis(prefix)
+
+
+# -- FUSE mount backend (charybdefs parity) ----------------------------------
+
+FUSE_BIN = f"{TOOL_DIR}/fusefaultfs"
+CTL_NAME = ".faultfs-ctl"
+
+
+def install_fuse(
+    session: Session,
+    backing: str,
+    mountpoint: str,
+) -> None:
+    """Upload + compile + mount the FUSE fault filesystem on a node
+    (charybdefs.clj:40-65's install!: build on node, mount backing
+    over mountpoint). The daemon self-daemonizes; re-running replaces
+    any prior mount."""
+    session.exec("mkdir", "-p", TOOL_DIR, backing, mountpoint,
+                 sudo=True)
+    session.exec("chmod", "777", TOOL_DIR, backing, mountpoint,
+                 sudo=True)
+    src = f"{TOOL_DIR}/fusefaultfs.cc"
+    session.upload(os.path.join(_RES, "fusefaultfs.cc"), src)
+    session.exec(
+        "g++", "-O3", "-std=c++17", "-o", FUSE_BIN, src,
+    )
+    # Replace, don't stack: a prior daemon (and its mount) may still
+    # be alive from an earlier setup; a busy mount needs the lazy
+    # detach. pkill -x matches the binary's comm exactly — never this
+    # wrapper shell.
+    session.exec(
+        "sh", "-c",
+        "pkill -x fusefaultfs 2>/dev/null; "
+        f"umount {mountpoint} 2>/dev/null || "
+        f"umount -l {mountpoint} 2>/dev/null || true",
+        sudo=True,
+    )
+    session.exec(FUSE_BIN, backing, mountpoint, sudo=True)
+
+
+def fuse_ctl(session: Session, mountpoint: str, command: str) -> None:
+    """Send a control command to a mounted fault filesystem:
+    clear | break <class> [errno N] | flaky <class> <basis_points>
+    [errno N] | delay <class> <us> | filter <substr|->  where class is
+    all|read|write|meta (charybdefs.clj:67-85's fault API)."""
+    session.exec(
+        "sh", "-c", f"cat > {mountpoint}/{CTL_NAME}", stdin=command,
+        sudo=True,
+    )
+
+
+def fuse_status(session: Session, mountpoint: str) -> str:
+    return session.exec("cat", f"{mountpoint}/{CTL_NAME}", sudo=True)
+
+
+def fuse_unmount(session: Session, mountpoint: str) -> None:
+    session.exec(
+        "sh", "-c",
+        f"umount {mountpoint} 2>/dev/null || "
+        f"umount -l {mountpoint} 2>/dev/null || true",
+        sudo=True,
+    )
+
+
+class FuseFaultFSNemesis(Nemesis):
+    """Mount-level disk faults (charybdefs.clj:67-85) — afflicts any
+    process writing through the mount, statically-linked included:
+
+    - start: every file op under the mount fails EIO (break-all)
+    - flaky: value = percent of ops failing (default 1, the
+      reference's break-one-percent)
+    - delay: value = microseconds added per op
+    - clear / stop: faults off
+
+    Op values may instead be {node: spec} dicts to target subsets.
+    """
+
+    def __init__(self, backing: str, mountpoint: str):
+        self.backing = backing
+        self.mountpoint = mountpoint
+
+    def setup(self, test) -> "FuseFaultFSNemesis":
+        def fn(node, sess):
+            install_fuse(sess, self.backing, self.mountpoint)
+
+        on_nodes(test, fn)
+        return self
+
+    def invoke(self, test, op: Op) -> Op:
+        def cmd_for(v) -> str:
+            if op.f == "start":
+                return "break all"
+            if op.f == "flaky":
+                pct = float(v) if v is not None else 1.0
+                return f"flaky all {int(pct * 100)}"
+            if op.f == "delay":
+                us = int(v) if v is not None else 100_000
+                return f"delay all {us}"
+            if op.f in ("clear", "stop"):
+                return "clear"
+            raise ValueError(
+                f"fuse faultfs nemesis can't handle f={op.f!r}"
+            )
+
+        def fn(node, sess, v):
+            cmd = cmd_for(v)
+            fuse_ctl(sess, self.mountpoint, cmd)
+            return cmd
+
+        return _dispatch_per_node(test, op, fn)
+
+    def teardown(self, test) -> None:
+        try:
+            on_nodes(
+                test,
+                lambda node, sess: fuse_ctl(
+                    sess, self.mountpoint, "clear"
+                ),
+            )
+        except Exception:
+            pass
+
+
+def fuse_faultfs_nemesis(
+    backing: str, mountpoint: str
+) -> FuseFaultFSNemesis:
+    return FuseFaultFSNemesis(backing, mountpoint)

@@ -524,7 +524,19 @@ def run(test: Dict[str, Any]) -> Dict[str, Any]:
                     pass
 
             _on_nodes(test, _td)
+        # Log snarfing (core.clj:98-149 with-log-snarfing): download
+        # every node's DB logs into <run_dir>/<node>/ after teardown.
+        # Living in this finally, it also runs when the test dies —
+        # a poisoned generator, a worker crash, or a Ctrl-C
+        # (KeyboardInterrupt propagating through the joins) — the
+        # reference's JVM-shutdown-hook role.
+        if db is not None and test.get("run_dir"):
+            from jepsen_tpu.db import snarf_logs as _snarf_logs
 
+            try:
+                _snarf_logs(test, test["run_dir"])
+            except Exception:
+                pass  # best-effort, like the shutdown hook
 
     if sched.poisoned is not None:
         for w in workers + [nw]:
