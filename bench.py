@@ -1,30 +1,55 @@
 """Benchmark: BASELINE configs on the TPU linearizability engine.
 
 Configs exercised (BASELINE.md):
-  1. etcd-style single-key CAS register, 1k-op recorded history
-     (Pallas megakernel path).
+  1. etcd-style single-key CAS register, 1k-op recorded history.
   2. zookeeper-style linearizable register, 10k ops x 16 independent
      keys (vmap key-batch path, checker/sharded.check_keys).
-  N. north star: 100k-op single-key CAS-register history, <60 s budget
-     (Pallas megakernel path).
+  3. tidb-style bank transfer, 50k ops (columnar device reduction).
+  4. cockroachdb-style G2 anti-dependency search, 100k-op history.
+  5. hazelcast-style long-fork, 256 keys x 500k ops.
+  N. north star: 100k-op single-key CAS register, <60 s budget.
 
 Prints ONE JSON line:
   {"metric": "ops_verified_per_sec", "value": N, "unit": "ops/s",
-   "vs_baseline": M}
+   "vs_baseline": M, ...}
 
-value is total ops verified across configs / total device wall-clock;
-vs_baseline is the geometric mean of per-config speedups over the CPU
-frontier oracle checking the same event streams on this host — the
-stand-in for knossos.wgl's role (the reference delegates linearizability
-to knossos on the control-node JVM and publishes no numbers, so the
-measured CPU oracle is the honest comparison point). Every verdict is
-asserted equal between engine and oracle before timing counts.
+vs_baseline is the geometric mean of per-config speedups over the
+STRONGEST honest CPU baseline measured on this host, per config:
+
+- Linearizability configs (1, 2, north star): the reference delegates
+  to knossos.wgl on the control-node JVM (checker.clj:127-158), so the
+  denominator is the faster of (a) the bounded-pmap Python oracle
+  across all host cores (independent.clj:266-288's key-parallelism —
+  knossos's own per-key wgl search is sequential, so cores only buy
+  key fan-out) and (b) the native C++ oracle (wgl_native.cc), the same
+  frontier algorithm on a compiled runtime — an upper bound on what a
+  JVM core can do. The C++/Python ratio is printed as the published
+  calibration factor standing in for "real knossos on a JVM" (no JVM
+  exists in this image; BENCH_NOTES.md discusses).
+- Reduction configs (3, 4, 5): reference-shaped Python folds over op
+  records — the same algorithm class as the reference's Clojure
+  reduces over persistent maps (comparable constant factors; disclosed
+  in BENCH_NOTES.md), extrapolation disclosed where used.
+
+vs_python_oracle is the same geomean against the single-strand Python
+oracle only — the continuity number comparable with rounds 1-3.
+
+Every verdict is asserted equal between engine and baseline before
+timing counts.
 
 Timing boundary: both sides consume the PRE-ENCODED event stream (the
 framework's native stored form) and pay their FULL check cost every
 timed rep — the engine's derived-tensor memos are cleared between reps
 (_uncached), because the primary scenario is the analyze seam's
 one-check-per-history, and the oracle keeps no derived state either.
+
+Tunnel-floor discipline: every synchronous device call through the
+axon tunnel pays a ~0.1-0.15 s round trip that local TPU hardware does
+not. The register plane therefore ALSO runs fully pipelined — configs
+1+2 batched into one kernel launch and the north star's segments
+dispatched behind them, one host sync for everything — and prints that
+wall (`register_plane_pipelined`) next to the per-config solo walls.
+The measured floor is printed every run.
 """
 
 from __future__ import annotations
@@ -63,22 +88,77 @@ def _time(fn, reps=1):
     return best, out
 
 
-def bench_config1():
-    """etcd 1k-op single-key CAS register histories.
+# -- CPU baselines -----------------------------------------------------------
 
-    One history is RECORDED by the actual runtime (in-memory register
-    workload through run() — real workers, real crash-cycling), the
-    rest simulated; the TPU number is batch throughput over 8 such
-    histories in ONE kernel launch + sync (the realistic way to use an
-    accelerator, and the only honest one under this environment's
-    ~100ms host-device round-trip floor, which otherwise dominates any
-    single 1k-op check). Per-check latency is reported alongside.
+
+def _oracle_baselines(streams):
+    """Strongest honest CPU denominators for a set of register event
+    streams. Three measurements:
+
+    - python_wall: SERIAL single-strand Python oracle — the continuity
+      denominator comparable with rounds 1-3.
+    - python_pmap_wall: the bounded-pmap fan-out over all host cores
+      (same as python_wall on a 1-core host, so not re-measured there).
+    - native_wall: the C++ oracle — only when EVERY stream fits its
+      envelope (window <= 64); a partial run would time no-ops.
+
+    best_wall = min(python_pmap, native): the strongest measured CPU
+    run for this input on this host.
     """
+    import os as _os
+
+    from jepsen_tpu.checker.wgl_oracle import check_streams
+    from jepsen_tpu.checker.wgl_native import check_events_native
+
+    out = {}
+    t0 = time.perf_counter()
+    verdicts_py, _ = check_streams(
+        streams, native=False, processes=1
+    )
+    out["python_wall"] = time.perf_counter() - t0
+    cores = _os.cpu_count() or 1
+    out["cores"] = cores
+    if cores > 1 and len(streams) > 1:
+        t0 = time.perf_counter()
+        verdicts_pm, _ = check_streams(streams, native=False)
+        out["python_pmap_wall"] = time.perf_counter() - t0
+        assert verdicts_pm == verdicts_py
+    else:
+        out["python_pmap_wall"] = out["python_wall"]
+
+    t0 = time.perf_counter()
+    verdicts_cc = [check_events_native(s) for s in streams]
+    if all(v is not None for v in verdicts_cc):
+        out["native_wall"] = time.perf_counter() - t0
+        assert verdicts_cc == verdicts_py, "oracle disagreement"
+    else:
+        # Toolchain missing or some stream outside the native envelope
+        # (window > 64): no honest native number exists for this input.
+        out["native_wall"] = None
+    out["verdicts"] = verdicts_py
+
+    walls = [
+        w for w in (out["python_pmap_wall"], out["native_wall"])
+        if w is not None
+    ]
+    out["best_wall"] = min(walls)
+    out["method"] = (
+        "min(python-pmap x%d cores, native C++)" % cores
+        if out["native_wall"] is not None
+        else "python-pmap x%d cores" % cores
+    )
+    return out
+
+
+# -- register plane (configs 1, 2, north star) -------------------------------
+
+
+def _etcd_streams():
+    """8 x 1k-op etcd-style histories: one RECORDED by the actual
+    runtime (in-memory register workload through run() — real workers,
+    real crash-cycling), the rest simulated."""
     import jepsen_tpu.generator.pure as gen
     from jepsen_tpu.checker.events import history_to_events
-    from jepsen_tpu.checker.linearizable import check_events_bucketed
-    from jepsen_tpu.checker.sharded import check_keys
-    from jepsen_tpu.checker.wgl_oracle import check_events as oracle
     from jepsen_tpu.runtime import AtomClient, run
     from jepsen_tpu.sim import gen_register_history
     from jepsen_tpu.workloads.register import op_mix
@@ -99,74 +179,181 @@ def bench_config1():
             p_crash=0.01,
         )
         streams.append(history_to_events(h))
-    n_ops = sum(s.n_ops for s in streams)
+    return streams
 
-    check_keys(streams)  # warmup/compile
-    check_events_bucketed(streams[1])  # warmup the single-check shape
-    tpu_wall, results = _time(
-        _uncached(lambda: check_keys(streams), streams), reps=3
+
+def _zk_streams():
+    from jepsen_tpu.checker.events import history_to_events
+    from jepsen_tpu.sim import gen_register_history
+
+    return [
+        history_to_events(gen_register_history(
+            random.Random(1000 + key), n_ops=625, n_procs=5,
+            p_crash=0.005,
+        ))
+        for key in range(16)
+    ]
+
+
+def _northstar_stream():
+    from jepsen_tpu.checker.events import history_to_events
+    from jepsen_tpu.sim import gen_register_history
+
+    h = gen_register_history(
+        random.Random(9), n_ops=100_000, n_procs=5, p_crash=0.0002
     )
+    return history_to_events(h)
+
+
+def bench_register_plane():
+    """Configs 1, 2 and the north star: solo walls per config (each
+    pays its own sync), plus the fully pipelined wall — both key
+    batches and the north star's segments dispatched back-to-back with
+    ONE host sync for everything (launch/collect split in wgl_bitset).
+    """
+    from jepsen_tpu.checker.linearizable import check_events_bucketed
+    from jepsen_tpu.checker.sharded import check_keys
+
+    etcd = _etcd_streams()
+    zk = _zk_streams()
+    ns = _northstar_stream()
+
+    # CPU baselines first (no device risk; verdict gates too).
+    b_etcd = _oracle_baselines(etcd)
+    b_zk = _oracle_baselines(zk)
+    # North-star Python oracle costs ~47-50 s; measured in full (not
+    # extrapolated — the frontier widens as crashed ops accumulate).
+    b_ns = _oracle_baselines([ns])
+    assert all(b_etcd["verdicts"]) and all(b_zk["verdicts"])
+    assert b_ns["verdicts"] == [True]
+
+    # Warmups (compile + shape caches).
+    r_etcd = check_keys(etcd)
+    r_zk = check_keys(zk)
+    r_ns = check_events_bucketed(ns)
+    for r, want in zip(r_etcd + r_zk + [r_ns],
+                       b_etcd["verdicts"] + b_zk["verdicts"]
+                       + b_ns["verdicts"]):
+        assert r["valid?"] == want is True, (r, want)
+
+    # Solo walls (each config pays its own launch + sync).
+    etcd_wall, r_etcd = _time(
+        _uncached(lambda: check_keys(etcd), etcd), reps=3
+    )
+    zk_wall, r_zk = _time(_uncached(lambda: check_keys(zk), zk), reps=3)
+    ns_wall, r_ns = _time(
+        _uncached(lambda: check_events_bucketed(ns), [ns]), reps=3
+    )
+    assert ns_wall < 60, f"north-star budget blown: {ns_wall:.1f}s"
     single_wall, r1 = _time(
-        _uncached(
-            lambda: check_events_bucketed(streams[1]), streams[1:2]
-        ),
+        _uncached(lambda: check_events_bucketed(etcd[1]), etcd[1:2]),
         reps=3,
     )
-    t0 = time.perf_counter()
-    wants = [oracle(s) for s in streams]
-    oracle_wall = time.perf_counter() - t0
-    for r, want in zip(results, wants):
-        assert r["valid?"] == want is True, (r, want)
     print(
         f"etcd-1k single-check latency: {single_wall:.3f}s "
         f"({r1['method']}; ~0.1s of that is the tunnel round trip)",
         file=sys.stderr,
     )
-    return {
-        "name": "etcd-1k",
-        "n_ops": n_ops,
-        "tpu_wall": tpu_wall,
-        "oracle_wall": oracle_wall,
-        "method": results[0]["method"] + " x8 batch, 1 recorded",
-    }
 
-
-def bench_config2():
-    """zookeeper 10k ops x 16 independent keys, vmap key batch."""
-    from jepsen_tpu.checker.events import history_to_events
-    from jepsen_tpu.checker.sharded import check_keys
-    from jepsen_tpu.checker.wgl_oracle import check_events as oracle
-    from jepsen_tpu.sim import gen_register_history
-
-    streams = []
-    for key in range(16):
-        h = gen_register_history(
-            random.Random(1000 + key), n_ops=625, n_procs=5, p_crash=0.005
-        )
-        streams.append(history_to_events(h))
-    n_ops = sum(s.n_ops for s in streams)
-    check_keys(streams)  # warmup/compile
-    tpu_wall, results = _time(
-        _uncached(lambda: check_keys(streams), streams), reps=3
+    # Pipelined: one dispatch train, one sync, whole register plane.
+    pipe_wall, pipe_ok = _time(
+        lambda: _register_plane_pipelined(etcd, zk, ns), reps=3
     )
-    t0 = time.perf_counter()
-    wants = [oracle(s) for s in streams]
-    oracle_wall = time.perf_counter() - t0
-    for r, want in zip(results, wants):
-        assert r["valid?"] == want is True, (r, want)
-    return {
-        "name": "zookeeper-10kx16",
-        "n_ops": n_ops,
-        "tpu_wall": tpu_wall,
-        "oracle_wall": oracle_wall,
-        "method": results[0]["method"],
+    if pipe_ok is not None:
+        assert pipe_ok, "pipelined verdicts diverged"
+
+    n_etcd = sum(s.n_ops for s in etcd)
+    n_zk = sum(s.n_ops for s in zk)
+    configs = [
+        {
+            "name": "etcd-1k",
+            "n_ops": n_etcd,
+            "tpu_wall": etcd_wall,
+            "oracle_wall": b_etcd["best_wall"],
+            "python_wall": b_etcd["python_wall"],
+            "native_wall": b_etcd["native_wall"],
+            "baseline": b_etcd["method"],
+            "method": r_etcd[0]["method"] + " x8 batch, 1 recorded",
+            "results": r_etcd,
+            "windows": [s.window for s in etcd],
+        },
+        {
+            "name": "zookeeper-10kx16",
+            "n_ops": n_zk,
+            "tpu_wall": zk_wall,
+            "oracle_wall": b_zk["best_wall"],
+            "python_wall": b_zk["python_wall"],
+            "native_wall": b_zk["native_wall"],
+            "baseline": b_zk["method"],
+            "method": r_zk[0]["method"],
+            "results": r_zk,
+            "windows": [s.window for s in zk],
+        },
+        {
+            "name": "northstar-100k",
+            "n_ops": ns.n_ops,
+            "tpu_wall": ns_wall,
+            "oracle_wall": b_ns["best_wall"],
+            "python_wall": b_ns["python_wall"],
+            "native_wall": b_ns["native_wall"],
+            "baseline": b_ns["method"],
+            "method": r_ns["method"],
+            "results": [r_ns],
+            "windows": [ns.window],
+        },
+    ]
+    pipeline = {
+        "wall": pipe_wall,
+        "n_ops": n_etcd + n_zk + ns.n_ops,
+        "available": pipe_ok is not None,
     }
+    return configs, pipeline
+
+
+def _register_plane_pipelined(etcd, zk, ns):
+    """Dispatch configs 1+2 as ONE batched kernel launch and the north
+    star's segment chain right behind it, then sync everything with a
+    single collect train. Returns True when all verdicts hold, None
+    when the bitset plan doesn't cover the inputs (non-TPU backend)."""
+    from jepsen_tpu.checker import wgl_bitset as bs
+    from jepsen_tpu.checker.events import clear_memos, events_to_steps
+    from jepsen_tpu.checker.linearizable import _on_tpu
+    from jepsen_tpu.checker.models import model as get_model
+
+    if not _on_tpu():
+        return None
+    m = get_model("cas-register")
+    batch = list(etcd) + list(zk)
+    window = max(s.window for s in batch)
+    plan = bs.plan(m, window, max(len(s.value_codes) for s in batch))
+    ns_plan = bs.plan(m, ns.window, len(ns.value_codes))
+    if plan is None or ns_plan is None:
+        return None
+    for s in batch + [ns]:
+        clear_memos(s)
+    bW, S = plan
+    steps = [events_to_steps(s, W=bW) for s in batch]
+    nsW, nsS = ns_plan
+    ns_steps = events_to_steps(ns, W=nsW)
+    h_batch = bs.launch_keys_bitset(steps, model="cas-register", S=S)
+    h_ns = bs.launch_steps_bitset_segmented(
+        ns_steps, model="cas-register", S=nsS
+    )
+    batch_verdicts = bs.collect_keys_bitset(h_batch)
+    ns_verdict = bs.collect_steps_bitset_segmented(ns_steps, h_ns)
+    ok = all(v[0] and not v[1] for v in batch_verdicts)
+    ok = ok and ns_verdict[0] and not ns_verdict[1]
+    return ok
+
+
+# -- reduction configs (3, 4, 5) ---------------------------------------------
 
 
 def bench_config3():
     """tidb-style bank transfer, 50k ops, 8 accounts: columnar device
     reduction vs the reference's per-read fold (bank.clj:84-121) as a
-    Python loop."""
+    reference-shaped Python loop (same algorithm class as the Clojure
+    reduce — BENCH_NOTES.md discusses the constant factor)."""
     from jepsen_tpu.checker.bank import BankChecker
     from jepsen_tpu.sim import gen_bank_history
 
@@ -207,6 +394,7 @@ def bench_config3():
         "n_ops": len(h.ops) // 2,
         "tpu_wall": tpu_wall,
         "oracle_wall": oracle_wall,
+        "baseline": "reference-shaped python fold",
         "method": "columnar-reduce",
     }
 
@@ -218,7 +406,7 @@ def bench_config4():
     reference checker's actual reduce shape), the columnar engine
     reduces the dense G2 plane (the form this framework records and
     persists histories in — encoded once, outside the timed region,
-    exactly as configs 1/2/6 pre-encode their event streams)."""
+    exactly as the register configs pre-encode their event streams)."""
     from jepsen_tpu.checker.adya import G2Checker
     from jepsen_tpu.sim import gen_g2_history
 
@@ -260,6 +448,7 @@ def bench_config4():
         "n_ops": len(h.ops) // 2,
         "tpu_wall": tpu_wall,
         "oracle_wall": oracle_wall,
+        "baseline": "reference-shaped python fold",
         "method": "columnar-group-count",
     }
 
@@ -317,41 +506,39 @@ def bench_config5():
         "n_ops": len(h.ops) // 2,
         "tpu_wall": tpu_wall,
         "oracle_wall": oracle_wall,
-        "method": "state-dedup+matmul (baseline extrapolated "
-                  f"from {sub_groups}/{n_groups} groups)",
+        "baseline": "reference-shaped python pairwise, extrapolated "
+                    f"from {sub_groups}/{n_groups} groups",
+        "method": "state-dedup+matmul",
     }
 
 
-def bench_north_star():
-    """100k-op single-key CAS register, <60 s budget."""
-    from jepsen_tpu.checker.events import history_to_events
-    from jepsen_tpu.checker.linearizable import check_events_bucketed
-    from jepsen_tpu.checker.wgl_oracle import check_events as oracle
-    from jepsen_tpu.sim import gen_register_history
+# -- engine statistics (VERDICT r3 #9) ---------------------------------------
 
-    h = gen_register_history(
-        random.Random(9), n_ops=100_000, n_procs=5, p_crash=0.0002
-    )
-    ev = history_to_events(h)
-    r = check_events_bucketed(ev)  # warmup/compile
-    tpu_wall, r = _time(
-        _uncached(lambda: check_events_bucketed(ev), [ev]), reps=3
-    )
-    assert tpu_wall < 60, f"north-star budget blown: {tpu_wall:.1f}s"
-    assert r["valid?"] is True, r
-    # Full-history oracle, measured (not extrapolated — the frontier
-    # widens as crashed ops accumulate, so prefix extrapolation would
-    # understate it ~2x). Costs ~47 s of bench wall-clock; the verdict
-    # doubles as the parity gate on the exact north-star input.
-    oracle_wall, want = _time(lambda: oracle(ev))
-    assert want is True and r["valid?"] == want
+
+def _engine_stats(register_configs):
+    """Aggregate which engine decided each key, window distribution,
+    escalations, taints — the measured ladder/envelope behavior
+    (VERDICT r3 #9: the W>16 cliff should be measured, not anecdotal).
+    """
+    from collections import Counter
+
+    engines = Counter()
+    windows = Counter()
+    escalations = 0
+    taints = 0
+    for c in register_configs:
+        for r in c.get("results", []):
+            engines[r.get("method", "?")] += 1
+            escalations += r.get("escalations", 0) or 0
+            if r.get("taint"):
+                taints += 1
+        for w in c.get("windows", []):
+            windows[w] += 1
     return {
-        "name": "northstar-100k",
-        "n_ops": ev.n_ops,
-        "tpu_wall": tpu_wall,
-        "oracle_wall": oracle_wall,
-        "method": f"{r['method']} (oracle measured on the full "
-                  "history)",
+        "engines": dict(engines),
+        "windows": {str(k): v for k, v in sorted(windows.items())},
+        "escalations": escalations,
+        "taints": taints,
     }
 
 
@@ -408,27 +595,53 @@ def main() -> None:
 
     import jax
 
-    configs = [
-        bench_config1(),
-        bench_config2(),
+    register_configs, pipeline = bench_register_plane()
+    configs = register_configs + [
         bench_config3(),
         bench_config4(),
         bench_config5(),
-        bench_north_star(),
     ]
 
     total_ops = sum(c["n_ops"] for c in configs)
     total_tpu = sum(c["tpu_wall"] for c in configs)
     speedups = [c["oracle_wall"] / c["tpu_wall"] for c in configs]
     geomean = math.exp(sum(math.log(s) for s in speedups) / len(speedups))
+    py_speedups = [
+        (c.get("python_wall") or c["oracle_wall"]) / c["tpu_wall"]
+        for c in configs
+    ]
+    py_geomean = math.exp(
+        sum(math.log(s) for s in py_speedups) / len(py_speedups)
+    )
 
-    for c, s in zip(configs, speedups):
+    for c, s, ps in zip(configs, speedups, py_speedups):
+        nat = (
+            f" native={c['native_wall']:.3f}s"
+            if c.get("native_wall") is not None
+            else ""
+        )
+        py = (
+            f" python={c['python_wall']:.3f}s"
+            if c.get("python_wall") is not None
+            else ""
+        )
         print(
             f"{c['name']}: n_ops={c['n_ops']} tpu={c['tpu_wall']:.3f}s "
-            f"oracle={c['oracle_wall']:.3f}s speedup={s:.1f}x "
+            f"baseline={c['oracle_wall']:.3f}s [{c['baseline']}]"
+            f"{py}{nat} speedup={s:.1f}x vs_python={ps:.1f}x "
             f"method={c['method']}",
             file=sys.stderr,
         )
+    if pipeline["available"]:
+        print(
+            f"register_plane_pipelined: {pipeline['n_ops']} ops in "
+            f"{pipeline['wall']:.3f}s (one sync for configs 1+2+north "
+            f"star = {pipeline['n_ops'] / pipeline['wall']:.0f} ops/s)",
+            file=sys.stderr,
+        )
+    stats = _engine_stats(register_configs)
+    print(f"engine_stats: {json.dumps(stats)}", file=sys.stderr)
+
     # Measure the host<->device round-trip floor: under the axon tunnel
     # every synchronous device call pays it, which flattens the
     # small-history configs (local TPU hardware pays microseconds).
@@ -444,9 +657,11 @@ def main() -> None:
     print(
         f"devices={jax.devices()} total_ops={total_ops} "
         f"total_tpu={total_tpu:.3f}s geomean_speedup={geomean:.2f} "
+        f"vs_python_oracle={py_geomean:.2f} "
         f"sync_roundtrip_floor={rt * 1e3:.0f}ms",
         file=sys.stderr,
     )
+    ns = next(c for c in configs if c["name"] == "northstar-100k")
     print(
         json.dumps(
             {
@@ -454,6 +669,19 @@ def main() -> None:
                 "value": round(total_ops / total_tpu, 1),
                 "unit": "ops/s",
                 "vs_baseline": round(geomean, 3),
+                "vs_python_oracle": round(py_geomean, 3),
+                "baseline": "strongest measured CPU per config "
+                            "(see stderr + BENCH_NOTES.md)",
+                "host_cores": os.cpu_count(),
+                "northstar_speedup": round(
+                    ns["oracle_wall"] / ns["tpu_wall"], 2
+                ),
+                "pipelined_ops_per_sec": (
+                    round(pipeline["n_ops"] / pipeline["wall"], 1)
+                    if pipeline["available"]
+                    else None
+                ),
+                "engine_stats": stats,
             }
         )
     )

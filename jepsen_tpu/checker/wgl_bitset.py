@@ -501,36 +501,19 @@ def plan_segments(steps: ReturnSteps) -> List[Tuple[int, int, int]]:
     return segs
 
 
-def check_steps_bitset_segmented(
+def launch_steps_bitset_segmented(
     steps: ReturnSteps,
     model: str = "cas-register",
     S: int = 8,
     interpret: bool = False,
-) -> Tuple[bool, bool, int]:
-    """Multi-segment scan for crash-accumulating histories: the prefix
-    runs on the narrowest kernel its windows fit (per-op cost scales
-    16x per bucket), widening as crashed slots pile up, all segments
-    chained through the frontier in/out pair with NO host sync in
-    between (the embed is a device-side lane pad — a narrow mask space
-    is a lane prefix of the wide one). The host fetches every
-    segment's verdict in one device_get; the first death wins."""
+):
+    """Dispatch the multi-segment scan WITHOUT the final host fetch:
+    every segment chains through the frontier in/out pair on device
+    (the embed is a lane pad — a narrow mask space is a lane prefix of
+    the wide one), and the returned handle carries each segment's
+    device verdict + death frontier for a later collect."""
     segs = plan_segments(steps)
     name = model if isinstance(model, str) else model.name
-    if len(segs) == 1:
-        # Not worth multiple launches: one scan, shape-bucketed. The
-        # padded object memoizes on steps so re-checks reuse its
-        # packed device args.
-        padded = memo_on(
-            steps, "_padded_single", None,
-            lambda: steps.padded(bucket(max(len(steps), 1), 64)),
-        )
-        verdict = check_steps_bitset(
-            padded, model=model, S=S, interpret=interpret
-        )
-        fr = getattr(padded, "_death_frontier", None)
-        if fr is not None:
-            steps._death_frontier = fr
-        return verdict
     fr = jnp.asarray(init_frontier(steps.init_state, S, segs[0][2])[None])
     outs = []
     frs = []
@@ -555,7 +538,16 @@ def check_steps_bitset_segmented(
         )
         outs.append(out)
         frs.append(fr)
-    fetched = jax.device_get(tuple(outs))  # ONE fetch for all syncs
+    return outs, frs
+
+
+def collect_steps_bitset_segmented(
+    steps: ReturnSteps, handle
+) -> Tuple[bool, bool, int]:
+    """Block on a launch_steps_bitset_segmented handle: one device_get
+    for every segment's verdict; the first death wins."""
+    outs, frs = handle
+    fetched = jax.device_get(tuple(outs))
     taint = False
     for o, dead_fr in zip(fetched, frs):
         alive, t, died = _out_to_verdicts(np.asarray(o))[0]
@@ -564,6 +556,42 @@ def check_steps_bitset_segmented(
             steps._death_frontier = np.asarray(dead_fr)[0]
             return False, taint, died
     return True, taint, -1
+
+
+def check_steps_bitset_segmented(
+    steps: ReturnSteps,
+    model: str = "cas-register",
+    S: int = 8,
+    interpret: bool = False,
+) -> Tuple[bool, bool, int]:
+    """Multi-segment scan for crash-accumulating histories: the prefix
+    runs on the narrowest kernel its windows fit (per-op cost scales
+    16x per bucket), widening as crashed slots pile up, all segments
+    chained through the frontier in/out pair with NO host sync in
+    between. The host fetches every segment's verdict in one
+    device_get; the first death wins."""
+    segs = plan_segments(steps)
+    if len(segs) == 1:
+        # Not worth multiple launches: one scan, shape-bucketed. The
+        # padded object memoizes on steps so re-checks reuse its
+        # packed device args.
+        padded = memo_on(
+            steps, "_padded_single", None,
+            lambda: steps.padded(bucket(max(len(steps), 1), 64)),
+        )
+        verdict = check_steps_bitset(
+            padded, model=model, S=S, interpret=interpret
+        )
+        fr = getattr(padded, "_death_frontier", None)
+        if fr is not None:
+            steps._death_frontier = fr
+        return verdict
+    return collect_steps_bitset_segmented(
+        steps,
+        launch_steps_bitset_segmented(
+            steps, model=model, S=S, interpret=interpret
+        ),
+    )
 
 
 def decode_frontier(
@@ -639,15 +667,17 @@ def decode_frontier(
     }
 
 
-def check_keys_bitset(
+def launch_keys_bitset(
     steps_list,
     model: str = "cas-register",
     S: int = 8,
     interpret: bool = False,
-) -> List[Tuple[bool, bool, int]]:
-    """Batch of per-key exact checks in ONE kernel launch + host sync.
-    All steps must share W; lengths pad to a power-of-two bucket so one
-    compiled kernel serves every batch."""
+):
+    """Dispatch the batched per-key scan WITHOUT a host sync: returns
+    the device verdict array. Collecting later (collect_keys_bitset)
+    lets callers pipeline several batches' device work behind one
+    another — the tunnel's round-trip floor is paid once per pipeline,
+    not once per batch."""
     n = bucket(max(max(len(st) for st in steps_list), 1), 64)
     name = model if isinstance(model, str) else model.name
     W = steps_list[0].W
@@ -668,4 +698,24 @@ def check_keys_bitset(
         W=W,
         interpret=interpret,
     )
+    return out
+
+
+def collect_keys_bitset(out) -> List[Tuple[bool, bool, int]]:
+    """Block on a launch_keys_bitset handle and decode verdicts."""
     return _out_to_verdicts(np.asarray(out))
+
+
+def check_keys_bitset(
+    steps_list,
+    model: str = "cas-register",
+    S: int = 8,
+    interpret: bool = False,
+) -> List[Tuple[bool, bool, int]]:
+    """Batch of per-key exact checks in ONE kernel launch + host sync.
+    All steps must share W; lengths pad to a power-of-two bucket so one
+    compiled kernel serves every batch."""
+    return collect_keys_bitset(
+        launch_keys_bitset(steps_list, model=model, S=S,
+                           interpret=interpret)
+    )
