@@ -519,27 +519,24 @@ def _engine_stats(register_configs):
     """Aggregate which engine decided each key, window distribution,
     escalations, taints — the measured ladder/envelope behavior
     (VERDICT r3 #9: the W>16 cliff should be measured, not anecdotal).
-    """
+    Delegates to the product aggregator (independent.engine_stats, the
+    same block results.json carries); per-key batch results don't
+    record windows, so those come from the configs' streams."""
     from collections import Counter
 
-    engines = Counter()
-    windows = Counter()
-    escalations = 0
-    taints = 0
+    from jepsen_tpu.independent import engine_stats
+
+    stats = engine_stats(
+        r for c in register_configs for r in c.get("results", [])
+    ) or {"engines": {}, "escalations": 0, "taints": 0}
+    windows: Counter = Counter()
     for c in register_configs:
-        for r in c.get("results", []):
-            engines[r.get("method", "?")] += 1
-            escalations += r.get("escalations", 0) or 0
-            if r.get("taint"):
-                taints += 1
         for w in c.get("windows", []):
             windows[w] += 1
-    return {
-        "engines": dict(engines),
-        "windows": {str(k): v for k, v in sorted(windows.items())},
-        "escalations": escalations,
-        "taints": taints,
+    stats["windows"] = {
+        str(k): v for k, v in sorted(windows.items())
     }
+    return stats
 
 
 def _device_health_gate(timeout_s: float = 180.0) -> None:

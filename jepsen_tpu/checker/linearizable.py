@@ -105,6 +105,88 @@ def _bitset_plan(events: EventStream, m) -> Optional[tuple]:
     return bs.plan(m, events.window, len(events.value_codes))
 
 
+def _decode_value(events: EventStream):
+    """code -> original value decoder for failure reports (intern keys
+    are ("int", 2)-style tuples)."""
+    rev = {c: k for k, c in events.value_codes.items()}
+
+    def dec(c):
+        if c < 0:
+            return None
+        k = rev.get(c)
+        if isinstance(k, tuple) and len(k) == 2:
+            return k[1]
+        return k
+
+    return dec
+
+
+def oracle_failure_report(events: EventStream, stats: dict, model):
+    """Build the decode_frontier-shaped failure report from the Python
+    oracle's death material, so invalid verdicts carry the same
+    linear.svg-role artifact on every engine path (checker.clj:146-154).
+    Returns None when the stats carry no death configs (valid verdict,
+    or the native rung decided — callers re-run the Python oracle for
+    the report in that case: failure analysis is rare and worth it,
+    the reference budgets hours for report writing)."""
+    if "death_configs" not in stats:
+        return None
+    from jepsen_tpu.checker.models import model as get_model
+
+    m = get_model(model)
+    f_names: dict = {}
+    for name, code in m.f_names.items():
+        f_names.setdefault(code, str(name))
+    dec = _decode_value(events)
+    open_ops = stats["death_open_ops"]
+
+    def op_desc(slot: int) -> dict:
+        f, a, b = open_ops[slot]
+        name = f_names.get(f, "?")
+        d = {"slot": slot, "f": name, "value": dec(a)}
+        if name in ("cas", "compare-and-set"):
+            d["value"] = [dec(a), dec(b)]
+        return d
+
+    configs = []
+    for state, mask in stats["death_configs"]:
+        configs.append({
+            "state": dec(state) if isinstance(state, int) else state,
+            "linearized": [
+                op_desc(s) for s in sorted(open_ops)
+                if (mask >> s) & 1
+            ],
+            "pending": [
+                op_desc(s) for s in sorted(open_ops)
+                if not (mask >> s) & 1
+            ],
+        })
+    return {
+        "failed_op": op_desc(stats["death_slot"]),
+        "configs": configs,
+    }
+
+
+def _oracle_decide(events: EventStream, model):
+    """Oracle verdict + (on invalid) the failure report, re-running the
+    Python rung when the native one decided (it carries no frontier)."""
+    valid, stats = oracle_check_fast(
+        events, model=model, return_stats=True
+    )
+    failure = None
+    if not valid:
+        if "death_configs" not in stats:
+            from jepsen_tpu.checker.wgl_oracle import check_events
+
+            _, py_stats = check_events(
+                events, model=model, return_stats=True
+            )
+            py_stats["oracle"] = stats["oracle"]
+            stats = py_stats
+        failure = oracle_failure_report(events, stats, model)
+    return valid, stats, failure
+
+
 def check_events_bucketed(
     events: EventStream,
     model: str = "cas-register",
@@ -154,21 +236,9 @@ def check_events_bucketed(
                         decode_frontier,
                     )
 
-                    rev = {
-                        c: k for k, c in events.value_codes.items()
-                    }
-
-                    def dec(c):
-                        if c < 0:
-                            return None
-                        k = rev.get(c)
-                        # intern keys are ("int", 2)-style tuples
-                        if isinstance(k, tuple) and len(k) == 2:
-                            return k[1]
-                        return k
-
                     out["failure"] = decode_frontier(
-                        fr, bsteps, died, model, decode_value=dec
+                        fr, bsteps, died, model,
+                        decode_value=_decode_value(events),
                     )
             return out
     if W is None or not m.jax_capable:
@@ -179,9 +249,7 @@ def check_events_bucketed(
             if W is None
             else f"model {m.name} is host-only (rich state)"
         )
-        valid, stats = oracle_check_fast(
-            events, model=model, return_stats=True
-        )
+        valid, stats, failure = _oracle_decide(events, model)
         out = {
             "valid?": valid,
             "method": f"cpu-oracle-{stats['oracle']}",
@@ -191,6 +259,8 @@ def check_events_bucketed(
         }
         if not valid:
             out["failed_op_index"] = stats["failed_op_index"]
+            if failure is not None:
+                out["failure"] = failure
         return out
 
     steps = events_to_steps(events, W=W)
@@ -253,9 +323,7 @@ def check_events_bucketed(
                 out["failed_op_index"] = died
             return out
         escalations += 1
-    valid, stats = oracle_check_fast(
-        events, model=model, return_stats=True
-    )
+    valid, stats, failure = _oracle_decide(events, model)
     out = {
         "valid?": valid,
         "method": f"cpu-oracle-{stats['oracle']}",
@@ -265,6 +333,8 @@ def check_events_bucketed(
     }
     if not valid:
         out["failed_op_index"] = stats["failed_op_index"]
+        if failure is not None:
+            out["failure"] = failure
     return out
 
 
@@ -305,29 +375,70 @@ class LinearizableChecker:
                 init_value=self.init_value,
                 max_window=1 << 20,
             )
-            valid, stats = oracle_check_fast(
-                events, model=self.model, return_stats=True
+            valid, stats, failure = _oracle_decide(
+                events, self.model
             )
-            return {
+            out = {
                 "valid?": valid,
                 "method": f"cpu-oracle-{stats['oracle']}",
                 "n_ops": events.n_ops,
                 "wall_s": time.perf_counter() - t0,
             }
+            if not valid:
+                out["failed_op_index"] = stats["failed_op_index"]
+                if failure is not None:
+                    out["failure"] = failure
+            return out
 
         if self.use_tpu:
             out = check_events_bucketed(events, model=self.model)
         else:
-            valid, stats = oracle_check_fast(
-                events, model=self.model, return_stats=True
+            valid, stats, failure = _oracle_decide(
+                events, self.model
             )
             out = {
                 "valid?": valid,
                 "method": f"cpu-oracle-{stats['oracle']}",
             }
+            if not valid:
+                out["failed_op_index"] = stats["failed_op_index"]
+                if failure is not None:
+                    out["failure"] = failure
         out["n_ops"] = events.n_ops
         out["window"] = events.window
+        # Every invalid verdict carries a failure report: engines that
+        # return only the failing index (K-frontier rungs, the native
+        # oracle) get theirs harvested from the Python oracle — rare
+        # and worth the re-run (the reference budgets hours for report
+        # writing, checker.clj:155-158).
+        if out["valid?"] is False and "failure" not in out:
+            from jepsen_tpu.checker.wgl_oracle import check_events
+
+            _, py_stats = check_events(
+                events, model=self.model, return_stats=True
+            )
+            failure = oracle_failure_report(
+                events, py_stats, self.model
+            )
+            if failure is not None:
+                out["failure"] = failure
         out["wall_s"] = time.perf_counter() - t0
+        # Render the death report (the reference's linear.svg,
+        # checker.clj:146-154) next to results.json when a run dir is
+        # in play; per-key checks land in their key subdirectory.
+        run_dir = (opts or {}).get("subdirectory") or (
+            test.get("run_dir") if isinstance(test, dict) else None
+        )
+        if out["valid?"] is False and "failure" in out and run_dir:
+            from jepsen_tpu.checker.failure_viz import write_failure_svg
+
+            try:
+                out["failure_svg"] = write_failure_svg(
+                    out["failure"], run_dir,
+                    failed_op_index=out.get("failed_op_index"),
+                )
+            except OSError:
+                pass
         return out
 
 

@@ -126,17 +126,19 @@ def check_events(
             if prune and crashed_inv[i]:
                 crashed_mask |= 1 << s
         else:  # EV_RETURN of the op in slot s
-            frontier = _closure(
+            pre_filter = _closure(
                 frontier, open_ops, step, crashed_mask, prune=prune
             )
-            max_frontier = max(max_frontier, len(frontier))
+            max_frontier = max(max_frontier, len(pre_filter))
             frontier = {
                 (state, mask & ~(1 << s))
-                for state, mask in frontier
+                for state, mask in pre_filter
                 if (mask >> s) & 1
             }
-            del open_ops[s]
             if not frontier:
+                # Death: read the window BEFORE recycling the slot —
+                # the function returns here, so no copy is ever paid
+                # on the valid path.
                 if return_stats:
                     op_idx = (
                         int(events.op_index[i])
@@ -147,8 +149,15 @@ def check_events(
                         "max_frontier": max_frontier,
                         "failed_at": i,
                         "failed_op_index": op_idx,
+                        # Death report material (the linear.svg role):
+                        # the pre-filter frontier and the open window,
+                        # truncated like the reference's 10-config cap.
+                        "death_slot": s,
+                        "death_configs": sorted(pre_filter)[:10],
+                        "death_open_ops": dict(open_ops),
                     }
                 return False
+            del open_ops[s]
     if return_stats:
         return True, {
             "max_frontier": max_frontier,

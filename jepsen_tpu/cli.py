@@ -181,10 +181,13 @@ def cmd_analyze(args) -> int:
     st = Store(args.store)
     history = st.load_history(run_dir)
     test = st.load_test(run_dir)
+    # Resolve BEFORE checking: test.json may carry a stale absolute
+    # run_dir (runs relocated via zip export), and artifact-writing
+    # checkers (linear.svg, timeline) target test["run_dir"].
+    test["run_dir"] = run_dir
     checker = _checker_for(args.workload)
     results = checker.check(test, history, {})
     test["results"] = results
-    test["run_dir"] = run_dir
     st.save_2(test)
     print(f"analyzed {run_dir}: valid?={results.get('valid?')}")
     print(_epitaph(_exit_code(results)))

@@ -301,12 +301,50 @@ class IndependentChecker:
         valid = (
             False if any_false else ("unknown" if any_unknown else True)
         )
-        return {
+        out = {
             "valid?": valid,
             "key_count": len(subhistories),
             "results": results,
         }
+        stats = engine_stats(results.values())
+        if stats is not None:
+            out["engine_stats"] = stats
+        return out
 
 
 def independent_checker(checker) -> IndependentChecker:
     return IndependentChecker(checker)
+
+
+def engine_stats(verdicts) -> Optional[dict]:
+    """Aggregate engine/envelope statistics over per-key verdicts
+    (VERDICT r3 #9: which engine decided each key, the window
+    distribution, escalation counts, taints — measured, not
+    anecdotal). Returns None when no verdict carries engine fields
+    (non-linearizability checkers)."""
+    from collections import Counter
+
+    engines: Counter = Counter()
+    windows: Counter = Counter()
+    escalations = 0
+    taints = 0
+    seen = False
+    for r in verdicts:
+        if not isinstance(r, dict) or "method" not in r:
+            continue
+        seen = True
+        engines[r["method"]] += 1
+        escalations += r.get("escalations", 0) or 0
+        if r.get("taint"):
+            taints += 1
+        w = r.get("window")
+        if w is not None:
+            windows[w] += 1
+    if not seen:
+        return None
+    return {
+        "engines": dict(engines),
+        "windows": {str(k): v for k, v in sorted(windows.items())},
+        "escalations": escalations,
+        "taints": taints,
+    }

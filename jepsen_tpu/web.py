@@ -11,6 +11,7 @@ from __future__ import annotations
 import html
 import json
 import os
+from urllib.parse import quote
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import unquote
@@ -18,6 +19,13 @@ from urllib.parse import unquote
 from jepsen_tpu.store import Store
 
 _COLORS = {True: "#6db6569e", False: "#d2322d9e", None: "#efaf4199"}
+
+_CTYPES = {
+    ".json": "application/json",
+    ".jsonl": "application/json",
+    ".html": "text/html",
+    ".svg": "image/svg+xml",
+}
 
 
 def _validity_color(valid) -> str:
@@ -31,21 +39,48 @@ def render_index(store: Store) -> str:
             run_dir = store.path(name, stamp)
             results = store.load_results(run_dir)
             valid = results.get("valid?") if results else None
+            qname, qstamp = quote(name, safe=""), quote(stamp, safe="")
             rows.append(
                 f'<tr style="background:{_validity_color(valid)}">'
-                f'<td><a href="/files/{name}/{stamp}/">{html.escape(name)}'
+                f'<td><a href="/files/{qname}/{qstamp}/">'
+                f"{html.escape(name)}"
                 f"</a></td><td>{html.escape(stamp)}</td>"
-                f"<td>{html.escape(str(valid))}</td></tr>"
+                f"<td>{html.escape(str(valid))}</td>"
+                f'<td><a href="/zip/{qname}/{qstamp}">zip</a></td></tr>'
             )
     return (
         "<html><head><title>jepsen-tpu</title><style>"
         "body{font-family:sans-serif} table{border-collapse:collapse}"
         "td,th{padding:4px 12px;border:1px solid #ccc}</style></head>"
         "<body><h1>jepsen-tpu runs</h1><table>"
-        "<tr><th>test</th><th>time</th><th>valid?</th></tr>"
+        "<tr><th>test</th><th>time</th><th>valid?</th>"
+        "<th>export</th></tr>"
         + "".join(rows)
         + "</table></body></html>"
     )
+
+
+def zip_dir(root: str, rel: str):
+    """Zip a run directory (web.clj:237,256's zip export) into a
+    spooled temp file — big runs (snarfed DB logs, histories) spill to
+    disk instead of holding the archive in RAM per request. Returns
+    (file_obj, size, filename) or None when out of tree."""
+    import tempfile
+    import zipfile
+
+    full = os.path.normpath(os.path.join(root, rel))
+    if not _inside(root, full) or not os.path.isdir(full):
+        return None
+    buf = tempfile.SpooledTemporaryFile(max_size=16 << 20)
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for dirpath, _dirs, files in os.walk(full):
+            for f in files:
+                p = os.path.join(dirpath, f)
+                zf.write(p, os.path.relpath(p, full))
+    size = buf.tell()
+    buf.seek(0)
+    name = (rel.strip("/").replace("/", "-") or "store") + ".zip"
+    return buf, size, name
 
 
 def _inside(root: str, full: str) -> bool:
@@ -68,12 +103,14 @@ def render_dir(store: Store, rel: str) -> Optional[str]:
         p = os.path.join(rel, entry)
         slash = "/" if os.path.isdir(os.path.join(full, entry)) else ""
         items.append(
-            f'<li><a href="/files/{html.escape(p)}{slash}">'
+            f'<li><a href="/files/{quote(p)}{slash}">'
             f"{html.escape(entry)}{slash}</a></li>"
         )
     return (
         f"<html><body><h2>{html.escape(rel) or 'store'}</h2>"
-        f"<ul>{''.join(items)}</ul><a href='/'>&larr; runs</a></body></html>"
+        f"<ul>{''.join(items)}</ul>"
+        f"<a href='/zip/{quote(rel)}'>download .zip</a> | "
+        f"<a href='/'>&larr; runs</a></body></html>"
     )
 
 
@@ -110,14 +147,33 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send(body.encode())
                 return
             if os.path.isfile(full):
-                ctype = (
-                    "application/json" if full.endswith(
-                        (".json", ".jsonl")
-                    ) else "text/plain"
+                ctype = _CTYPES.get(
+                    os.path.splitext(full)[1], "text/plain"
                 )
                 with open(full, "rb") as f:
                     self._send(f.read(), ctype=ctype)
                 return
+        if path.startswith("/zip/"):
+            rel = path[len("/zip/"):].strip("/")
+            out = zip_dir(self.store.root, rel)
+            if out is None:
+                self._send(b"not found", code=404)
+                return
+            buf, size, name = out
+            self.send_response(200)
+            self.send_header("Content-Type", "application/zip")
+            self.send_header(
+                "Content-Disposition", f'attachment; filename="{name}"'
+            )
+            self.send_header("Content-Length", str(size))
+            self.end_headers()
+            try:
+                import shutil
+
+                shutil.copyfileobj(buf, self.wfile)
+            finally:
+                buf.close()
+            return
         self._send(b"not found", code=404)
 
 

@@ -422,3 +422,56 @@ def test_unordered_queue_model():
     assert check_events_bucketed(ev, model="unordered-queue")[
         "valid?"
     ] is True
+
+
+def test_invalid_verdict_renders_linear_svg(tmp_path):
+    """The checker.clj:146-154 role end-to-end: an invalid register
+    history checked with a run dir produces the failure report AND the
+    linear.svg artifact, whichever engine decided."""
+    h = H(
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(0, "read"),
+        ok_op(0, "read", 99),  # never written: unlinearizable
+    )
+    test = {"run_dir": str(tmp_path)}
+    out = LinearizableChecker().check(test, h)
+    assert out["valid?"] is False
+    assert out["failed_op_index"] is not None
+    f = out["failure"]
+    assert f["failed_op"]["f"] == "read" and f["failed_op"]["value"] == 99
+    assert f["configs"], f
+    # Every surviving config's state must be the written value.
+    assert all(c["state"] == 1 for c in f["configs"])
+    svg_path = out["failure_svg"]
+    assert svg_path.endswith("linear.svg")
+    svg = open(svg_path).read()
+    assert "read 99" in svg and "<svg" in svg
+
+    # Oracle-only mode produces the same artifact.
+    test2 = {"run_dir": str(tmp_path / "o")}
+    out2 = LinearizableChecker(use_tpu=False).check(test2, h)
+    assert out2["valid?"] is False and "failure" in out2
+    assert out2["failure"]["failed_op"]["value"] == 99
+
+
+def test_independent_results_carry_engine_stats(tmp_path):
+    """results.json for a keyed run carries the engine_stats block
+    (VERDICT r3 #9)."""
+    from jepsen_tpu import independent
+
+    h = H(
+        invoke_op(0, "write", independent.KV("a", 1)),
+        ok_op(0, "write", independent.KV("a", 1)),
+        invoke_op(1, "write", independent.KV("b", 2)),
+        ok_op(1, "write", independent.KV("b", 2)),
+        invoke_op(0, "read", independent.KV("a", None)),
+        ok_op(0, "read", independent.KV("a", 1)),
+    )
+    chk = independent.IndependentChecker(LinearizableChecker())
+    r = chk.check({}, h)
+    assert r["valid?"] is True
+    es = r["engine_stats"]
+    assert sum(es["engines"].values()) == 2  # one verdict per key
+    assert es["taints"] == 0
+    assert sum(es["windows"].values()) == 2
