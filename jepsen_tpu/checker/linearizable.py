@@ -151,7 +151,7 @@ def oracle_failure_report(events: EventStream, stats: dict, model):
     configs = []
     for state, mask in stats["death_configs"]:
         configs.append({
-            "state": dec(state) if isinstance(state, int) else state,
+            "state": m.state_repr(state, dec),
             "linearized": [
                 op_desc(s) for s in sorted(open_ops)
                 if (mask >> s) & 1
@@ -165,6 +165,20 @@ def oracle_failure_report(events: EventStream, stats: dict, model):
         "failed_op": op_desc(stats["death_slot"]),
         "configs": configs,
     }
+
+
+def _oracle_verdict(valid, stats, failure, **extra) -> dict:
+    """The one place a cpu-oracle verdict dict is assembled."""
+    out = {
+        "valid?": valid,
+        "method": f"cpu-oracle-{stats['oracle']}",
+        **extra,
+    }
+    if not valid:
+        out["failed_op_index"] = stats["failed_op_index"]
+        if failure is not None:
+            out["failure"] = failure
+    return out
 
 
 def _oracle_decide(events: EventStream, model):
@@ -241,29 +255,43 @@ def check_events_bucketed(
                         decode_value=_decode_value(events),
                     )
             return out
+    if (
+        W is not None
+        and not m.jax_capable
+        and m.packed_variant
+        and m.packed_ok is not None
+        and m.packed_ok(events)
+    ):
+        # Rich-state model whose bounded encoding fits a machine word
+        # (packed queue count-vectors): substitute the packed variant
+        # so the history rides the K-frontier kernels instead of
+        # detouring to the host oracle.
+        m = get_model(m.packed_variant)
+        model = m.name
     if W is None or not m.jax_capable:
         # Too concurrent for the masks, or the model's state doesn't
-        # fit a machine word (queue multisets): the oracle decides.
+        # fit a machine word (out-of-envelope queue multisets): the
+        # oracle decides.
         reason = (
             f"window {events.window} exceeds {W_BUCKETS[-1]} slots"
             if W is None
             else f"model {m.name} is host-only (rich state)"
         )
         valid, stats, failure = _oracle_decide(events, model)
-        out = {
-            "valid?": valid,
-            "method": f"cpu-oracle-{stats['oracle']}",
-            "frontier_k": None,
-            "escalations": 0,
-            "reason": reason,
-        }
-        if not valid:
-            out["failed_op_index"] = stats["failed_op_index"]
-            if failure is not None:
-                out["failure"] = failure
-        return out
+        return _oracle_verdict(
+            valid, stats, failure,
+            frontier_k=None, escalations=0, reason=reason,
+        )
 
     steps = events_to_steps(events, W=W)
+    ki = m.kernel_init_code(events.init_state)
+    if ki != steps.init_state:
+        # Packed models re-encode the initial state (e.g. empty
+        # multiset = 0, not the NIL code). Copy rather than mutate:
+        # the memoized steps object may serve other models.
+        import dataclasses
+
+        steps = dataclasses.replace(steps, init_state=ki)
     # Crash-heavy histories blow past the first rung almost surely (the
     # pruned frontier still grows with the crashed-op antichain), so
     # skip rungs that measured frontier statistics say are doomed: with
@@ -324,18 +352,11 @@ def check_events_bucketed(
             return out
         escalations += 1
     valid, stats, failure = _oracle_decide(events, model)
-    out = {
-        "valid?": valid,
-        "method": f"cpu-oracle-{stats['oracle']}",
-        "frontier_k": None,
-        "escalations": escalations,
-        "reason": f"frontier overflowed at K={k_ladder[-1]}",
-    }
-    if not valid:
-        out["failed_op_index"] = stats["failed_op_index"]
-        if failure is not None:
-            out["failure"] = failure
-    return out
+    return _oracle_verdict(
+        valid, stats, failure,
+        frontier_k=None, escalations=escalations,
+        reason=f"frontier overflowed at K={k_ladder[-1]}",
+    )
 
 
 class LinearizableChecker:
@@ -368,42 +389,23 @@ class LinearizableChecker:
                 history, model=self.model, init_value=self.init_value
             )
         except WindowOverflow:
-            # Too concurrent for int32 masks: unbounded oracle decides.
+            # Too concurrent for int32 masks: unbounded oracle decides
+            # (and flows into the shared tail below — overflow runs get
+            # the same failure artifact and fields as every other path).
             events = history_to_events(
                 history,
                 model=self.model,
                 init_value=self.init_value,
                 max_window=1 << 20,
             )
-            valid, stats, failure = _oracle_decide(
-                events, self.model
-            )
-            out = {
-                "valid?": valid,
-                "method": f"cpu-oracle-{stats['oracle']}",
-                "n_ops": events.n_ops,
-                "wall_s": time.perf_counter() - t0,
-            }
-            if not valid:
-                out["failed_op_index"] = stats["failed_op_index"]
-                if failure is not None:
-                    out["failure"] = failure
-            return out
-
-        if self.use_tpu:
-            out = check_events_bucketed(events, model=self.model)
+            out = _oracle_verdict(*_oracle_decide(events, self.model))
         else:
-            valid, stats, failure = _oracle_decide(
-                events, self.model
-            )
-            out = {
-                "valid?": valid,
-                "method": f"cpu-oracle-{stats['oracle']}",
-            }
-            if not valid:
-                out["failed_op_index"] = stats["failed_op_index"]
-                if failure is not None:
-                    out["failure"] = failure
+            if self.use_tpu:
+                out = check_events_bucketed(events, model=self.model)
+            else:
+                out = _oracle_verdict(
+                    *_oracle_decide(events, self.model)
+                )
         out["n_ops"] = events.n_ops
         out["window"] = events.window
         # Every invalid verdict carries a failure report: engines that

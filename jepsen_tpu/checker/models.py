@@ -151,6 +151,10 @@ class Model:
         crashed_droppable_fs: Tuple[int, ...] = (),
         bitset_slot_jax: Optional[Callable] = None,
         bitset_rows: Optional[Callable[[int], int]] = None,
+        kernel_init_code: Optional[Callable[[int], int]] = None,
+        packed_variant: Optional[str] = None,
+        packed_ok: Optional[Callable] = None,
+        state_repr: Optional[Callable] = None,
     ):
         self.name = name
         self.step_py = step_py
@@ -165,6 +169,14 @@ class Model:
         #: state rows the bitset frontier needs for a history with n
         #: interned value codes (row 0 is NIL)
         self._bitset_rows = bitset_rows
+        #: host-side int32 initial state for the K-frontier kernels
+        #: (identity for register-family; packed models re-encode)
+        self._kernel_init_code = kernel_init_code
+        #: device-capable substitute model + its envelope predicate
+        #: (rich-state models whose bounded encoding fits a word)
+        self.packed_variant = packed_variant
+        self.packed_ok = packed_ok
+        self._state_repr = state_repr
 
     def bitset_rows(self, n_value_codes: int) -> int:
         if self._bitset_rows is not None:
@@ -177,6 +189,23 @@ class Model:
         if self._initial is not None:
             return self._initial(init_code)
         return init_code
+
+    def kernel_init_code(self, init_code: int) -> int:
+        """int32 initial state the K-frontier kernels scan from."""
+        if self._kernel_init_code is not None:
+            return self._kernel_init_code(init_code)
+        return init_code
+
+    def state_repr(self, state, dec):
+        """Human-readable state for failure reports: ``dec`` maps a
+        value CODE back to the original value. Register-family states
+        ARE value codes; rich/packed models override via
+        _state_repr."""
+        if self._state_repr is not None:
+            return self._state_repr(state, dec)
+        if isinstance(state, int):
+            return dec(state)
+        return state
 
     def f_code(self, f) -> int:
         """Model f-code for an op.f, or -1 if the op is outside the model."""
@@ -257,6 +286,92 @@ def unordered_queue_step_py(state, f: int, a: int, b: int):
     raise ValueError(f"unknown f code {f}")
 
 
+# -- packed unordered queue: the device-capable encoding ---------------------
+#
+# A bounded multiset over a SMALL value domain packs into one int32 as
+# a count vector — 4 bits per value code, codes 0..6 (7 nibbles = 28
+# bits, keeping the int32 sign bit clear). Within that envelope the
+# queue's transition function is pure integer arithmetic, so queue
+# histories ride the SAME K-frontier kernels (wgl_jax / wgl_pallas) as
+# registers — no bitset-kernel surgery, no host-only detour. The
+# escalation ladder substitutes this model for "unordered-queue" when
+# packed_queue_envelope says the history fits; outside the envelope
+# the tuple-multiset oracle decides as before.
+
+PACKED_QUEUE_MAX_CODES = 7   # nibbles that fit below the sign bit
+PACKED_QUEUE_MAX_COUNT = 15  # per-value enqueue bound (one nibble)
+
+
+def unordered_queue_packed_step_py(state: int, f: int, a: int, b: int):
+    if a < 0:
+        # NIL value (crashed dequeue with unknown value): never
+        # linearizes — identical to the tuple model, where -1 is never
+        # a member of the multiset. (Enqueues of NIL are kept out of
+        # the packed path by the envelope check.)
+        return False, state
+    shift = 4 * a
+    if f == F_ENQ:
+        return True, state + (1 << shift)
+    if f == F_DEQ:
+        if (state >> shift) & 15:
+            return True, state - (1 << shift)
+        return False, state
+    raise ValueError(f"unknown f code {f}")
+
+
+def unordered_queue_packed_step_jax(state, f, a, b):
+    import jax.numpy as jnp
+
+    nil = a < 0
+    shift = 4 * jnp.maximum(a, 0)  # clamp: negative shifts are UB
+    cnt = (state >> shift) & 15
+    is_enq = f == F_ENQ
+    ok = ~nil & (is_enq | ((f == F_DEQ) & (cnt > 0)))
+    delta = jnp.where(is_enq, 1, -1) << shift
+    state2 = jnp.where(ok, state + delta, state)
+    return ok, state2
+
+
+def packed_queue_state_repr(state: int, dec):
+    """Unpack a count-vector state to {value: count} for reports."""
+    out = {}
+    for code in range(PACKED_QUEUE_MAX_CODES):
+        cnt = (state >> (4 * code)) & 15
+        if cnt:
+            out[dec(code)] = cnt
+    return out
+
+
+def tuple_queue_state_repr(state, dec):
+    return sorted((dec(c) for c in state), key=repr)
+
+
+def packed_queue_envelope(events) -> bool:
+    """True when the stream fits the packed count-vector encoding:
+    every value code < PACKED_QUEUE_MAX_CODES and no value enqueued
+    more than PACKED_QUEUE_MAX_COUNT times in total."""
+    import numpy as np
+
+    from jepsen_tpu.checker import events as ev
+
+    enq = (events.kind == ev.EV_INVOKE) & (events.f == F_ENQ)
+    codes = events.a[(events.kind != ev.EV_NOP)]
+    if codes.size and int(codes.max()) >= PACKED_QUEUE_MAX_CODES:
+        return False
+    enq_codes = events.a[enq]
+    if enq_codes.size and int(enq_codes.min()) < 0:
+        # Enqueue of NIL: representable in the tuple multiset but not
+        # in the count vector — tuple oracle decides.
+        return False
+    if enq_codes.size:
+        counts = np.bincount(
+            enq_codes, minlength=PACKED_QUEUE_MAX_CODES
+        )
+        if int(counts.max()) > PACKED_QUEUE_MAX_COUNT:
+            return False
+    return True
+
+
 MODELS: Dict[str, Model] = {
     "cas-register": Model(
         "cas-register", cas_register_step_py, cas_register_step_jax,
@@ -277,6 +392,16 @@ MODELS: Dict[str, Model] = {
     "unordered-queue": Model(
         "unordered-queue", unordered_queue_step_py, None, QUEUE_F_NAMES,
         jax_capable=False, initial=lambda init_code: (),
+        packed_variant="unordered-queue-packed",
+        packed_ok=packed_queue_envelope,
+        state_repr=tuple_queue_state_repr,
+    ),
+    "unordered-queue-packed": Model(
+        "unordered-queue-packed", unordered_queue_packed_step_py,
+        unordered_queue_packed_step_jax, QUEUE_F_NAMES,
+        initial=lambda init_code: 0,
+        kernel_init_code=lambda init_code: 0,
+        state_repr=packed_queue_state_repr,
     ),
 }
 

@@ -45,6 +45,7 @@ def stack_streams(
     streams: Sequence[EventStream],
     W: int,
     n_keys: Optional[int] = None,
+    model: str = "cas-register",
 ) -> Tuple[np.ndarray, ...]:
     """Precompile per-key event streams and stack into padded arrays:
     (occ [n_keys,n,W], f, a, b, slot [n_keys,n], live, init_state
@@ -82,7 +83,12 @@ def stack_streams(
     live = np.stack([st.live for st in steps])
     crashed = np.stack([st.crashed for st in steps])
     op_index = np.stack([st.op_index for st in steps])
-    init_state = np.asarray([st.init_state for st in steps], np.int32)
+    from jepsen_tpu.checker.models import model as get_model
+
+    kic = get_model(model).kernel_init_code
+    init_state = np.asarray(
+        [kic(st.init_state) for st in steps], np.int32
+    )
     return occ, f, a, b, slot, live, crashed, op_index, init_state
 
 
@@ -167,6 +173,50 @@ def check_keys(
     n_real = len(streams)
     if n_real == 0:
         return []
+    from jepsen_tpu.checker.models import model as get_model
+
+    m = get_model(model)
+    if not m.jax_capable:
+        in_env = (
+            [m.packed_ok(s) for s in streams]
+            if m.packed_variant and m.packed_ok is not None
+            else [False] * n_real
+        )
+        if all(in_env):
+            # Word-sized bounded encoding: the whole batch rides the
+            # kernels under the packed variant.
+            model = m.packed_variant
+            m = get_model(model)
+        elif any(in_env):
+            # Mixed batch: in-envelope keys keep the kernel path; only
+            # the offenders detour to the host oracle.
+            from jepsen_tpu.checker.wgl_oracle import check_streams
+
+            ok_idx = [i for i, e in enumerate(in_env) if e]
+            bad_idx = [i for i, e in enumerate(in_env) if not e]
+            kernel_res = check_keys(
+                [streams[i] for i in ok_idx],
+                model=m.packed_variant, mesh=mesh, k_ladder=k_ladder,
+            )
+            verdicts, meta = check_streams(
+                [streams[i] for i in bad_idx], model=model
+            )
+            merged: List[Optional[dict]] = [None] * n_real
+            for i, r in zip(ok_idx, kernel_res):
+                merged[i] = r
+            for i, v, rung in zip(bad_idx, verdicts, meta["rungs"]):
+                merged[i] = {
+                    "valid?": v, "method": f"cpu-oracle-{rung}",
+                }
+            return merged  # type: ignore[return-value]
+        else:
+            from jepsen_tpu.checker.wgl_oracle import check_streams
+
+            verdicts, meta = check_streams(streams, model=model)
+            return [
+                {"valid?": v, "method": f"cpu-oracle-{rung}"}
+                for v, rung in zip(verdicts, meta["rungs"])
+            ]
     window = max(max(s.window for s in streams), 1)
     W = _bucket_window(window)
     if W is None:
@@ -226,6 +276,21 @@ def check_keys(
             from jepsen_tpu.checker.wgl_pallas import check_keys_pallas
 
             steps = [events_to_steps(s, W=W) for s in streams]
+            kic = m.kernel_init_code
+            if any(
+                kic(s.init_state) != st.init_state
+                for s, st in zip(streams, steps)
+            ):
+                # Packed models re-encode the initial state; copy so
+                # the memoized steps stay untouched for other models.
+                import dataclasses
+
+                steps = [
+                    dataclasses.replace(
+                        st, init_state=kic(s.init_state)
+                    )
+                    for s, st in zip(streams, steps)
+                ]
             outs = check_keys_pallas(steps, model=model, K=K)
             alive = np.asarray([o[0] for o in outs])
             overflow = np.asarray([o[1] for o in outs])
@@ -263,7 +328,7 @@ def check_keys(
                             "method": f"cpu-oracle-{st['oracle']}",
                         })
             return out
-        cols = stack_streams(streams, W=W, n_keys=n_keys)
+        cols = stack_streams(streams, W=W, n_keys=n_keys, model=model)
         args = tuple(jnp.asarray(c) for c in cols)
         alive, overflow, died = _wgl_vmap(*args, model_name=model, K=K, W=W)
     else:
@@ -272,7 +337,7 @@ def check_keys(
         # (e.g. a virtual CPU mesh under an ambient TPU plugin).
         from jax.sharding import NamedSharding
 
-        cols = stack_streams(streams, W=W, n_keys=n_keys)
+        cols = stack_streams(streams, W=W, n_keys=n_keys, model=model)
         sharding = NamedSharding(mesh, key_spec(mesh))
         args = tuple(jax.device_put(np.asarray(c), sharding) for c in cols)
         fn = make_sharded_checker(mesh, model, K, W)
