@@ -28,6 +28,10 @@ from jepsen_tpu.control.util import (
     start_daemon,
     stop_daemon,
 )
+from jepsen_tpu.protocols.clients import (
+    DisqueQueueClient,
+    RespRegisterClient,
+)
 from jepsen_tpu.db import DB
 from jepsen_tpu.generator import pure as gen
 from jepsen_tpu.os import OS, Debian, SmartOS
@@ -109,6 +113,10 @@ SUITES: Dict[str, Dict[str, Any]] = {
     # redis + raft: register over redis-cli (raftis.clj:1-158)
     "raftis": {
         "ref": "raftis/src/jepsen/raftis.clj",
+        # Real mode speaks RESP to redis directly (protocols/clients).
+        "clients": {
+            "register": lambda opts: RespRegisterClient(port=6379),
+        },
         "db": RecipeDB(
             setup_cmds=[
                 ["apt-get", "install", "-y", "redis-server"],
@@ -125,6 +133,10 @@ SUITES: Dict[str, Dict[str, Any]] = {
     # disque: build from source, queue semantics (disque.clj:40-90)
     "disque": {
         "ref": "disque/src/jepsen/disque.clj",
+        # Real mode speaks disque's RESP commands (ADDJOB/GETJOB/ACKJOB).
+        "clients": {
+            "queue": lambda opts: DisqueQueueClient(port=7711),
+        },
         "db": RecipeDB(
             setup_cmds=[
                 ["apt-get", "install", "-y", "git", "build-essential"],
@@ -315,6 +327,14 @@ def make_test(
         test.pop("os", None)
         test.pop("db", None)
         test["net"] = netlib.MemNet()
+    else:
+        # Real mode: suites that declare a wire-protocol client for
+        # this workload use it instead of the generic in-memory one
+        # (the rethinkdb/disque discipline — their reference clients
+        # speak the actual protocol from the control node).
+        factory = entry.get("clients", {}).get(workload_name)
+        if factory is not None:
+            test["client"] = factory(opts)
     opts.pop("rng", None)
     test.update(opts)
     return test
