@@ -60,6 +60,15 @@ import random
 import sys
 import time
 
+#: --smoke: shrink every config so the whole bench program executes in
+#: seconds on any backend (CPU included) — a flow validation that the
+#: driver's real TPU run won't crash, not a measurement.
+SMOKE = False
+
+
+def _n(full: int, smoke: int) -> int:
+    return smoke if SMOKE else full
+
 
 def _uncached(fn, streams):
     """Wrap a check thunk so each call re-pays the stream-derived prep
@@ -168,14 +177,14 @@ def _etcd_streams():
         "name": "bench-etcd",
         "client": AtomClient(),
         "generator": gen.clients(gen.limit(
-            1000, gen.stagger(1 / 5000, op_mix(rng), rng=rng)
+            _n(1000, 60), gen.stagger(1 / 5000, op_mix(rng), rng=rng)
         )),
         "concurrency": 5,
     })["history"]
     streams = [history_to_events(recorded)]
     for seed in range(7):
         h = gen_register_history(
-            random.Random(100 + seed), n_ops=1000, n_procs=5,
+            random.Random(100 + seed), n_ops=_n(1000, 60), n_procs=5,
             p_crash=0.01,
         )
         streams.append(history_to_events(h))
@@ -188,7 +197,7 @@ def _zk_streams():
 
     return [
         history_to_events(gen_register_history(
-            random.Random(1000 + key), n_ops=625, n_procs=5,
+            random.Random(1000 + key), n_ops=_n(625, 40), n_procs=5,
             p_crash=0.005,
         ))
         for key in range(16)
@@ -200,7 +209,8 @@ def _northstar_stream():
     from jepsen_tpu.sim import gen_register_history
 
     h = gen_register_history(
-        random.Random(9), n_ops=100_000, n_procs=5, p_crash=0.0002
+        random.Random(9), n_ops=_n(100_000, 400), n_procs=5,
+        p_crash=0.0002,
     )
     return history_to_events(h)
 
@@ -359,7 +369,8 @@ def bench_config3():
 
     test = {"accounts": list(range(8)), "total_amount": 100}
     h = gen_bank_history(
-        random.Random(33), n_ops=50_000, n_accounts=8, total=100
+        random.Random(33), n_ops=_n(50_000, 500), n_accounts=8,
+        total=100,
     )
     checker = BankChecker()
     # Native in-memory forms on both sides (see bench_config4): the
@@ -410,7 +421,7 @@ def bench_config4():
     from jepsen_tpu.checker.adya import G2Checker
     from jepsen_tpu.sim import gen_g2_history
 
-    h = gen_g2_history(random.Random(44), n_keys=25_000)
+    h = gen_g2_history(random.Random(44), n_keys=_n(25_000, 300))
     checker = G2Checker()
     plane = G2Checker.encode(h)
     checker.check({}, plane)  # warmup
@@ -461,7 +472,8 @@ def bench_config5():
     from jepsen_tpu.checker.longfork import LongForkChecker
     from jepsen_tpu.sim import gen_long_fork_history
 
-    n_groups, per_group = 128, 3906  # ~500k ops over 256 keys
+    n_groups, per_group = _n(128, 4), _n(3906, 40)
+    # ~500k ops over 256 keys (full mode)
     h = gen_long_fork_history(
         random.Random(55), n_groups=n_groups, ops_per_group=per_group, n=2
     )
@@ -547,8 +559,14 @@ def _device_health_gate(timeout_s: float = 180.0) -> None:
     wedged device call cannot be interrupted in-process."""
     import subprocess
 
+    # The probe must honor an explicit JAX_PLATFORMS pin via config —
+    # the ambient accelerator plugin overrides the env var during
+    # discovery (so a CPU-pinned smoke run doesn't touch the tunnel).
     probe = (
-        "import jax, jax.numpy as jnp, numpy as np; "
+        "import os, jax; "
+        "p = os.environ.get('JAX_PLATFORMS'); "
+        "p and jax.config.update('jax_platforms', p); "
+        "import jax.numpy as jnp, numpy as np; "
         "np.asarray(jax.jit(lambda x: x + 1)(jnp.zeros(4))); "
         "print('healthy')"
     )
@@ -571,9 +589,16 @@ def _device_health_gate(timeout_s: float = 180.0) -> None:
 
 
 def main() -> None:
+    global SMOKE
+
+    if "--smoke" in sys.argv:
+        SMOKE = True
+        print("SMOKE MODE: flow validation, not a measurement",
+              file=sys.stderr)
     # Gate BEFORE importing jax: plugin registration itself can touch
-    # the wedged tunnel and hang the parent uninterruptibly.
-    _device_health_gate()
+    # the wedged tunnel and hang the parent uninterruptibly — smoke
+    # runs included (the probe is seconds on a healthy host).
+    _device_health_gate(timeout_s=60.0 if SMOKE else 180.0)
 
     # Persistent compilation cache: the bench runs in a fresh process
     # each round; cached executables shave minutes of XLA/Mosaic
@@ -591,6 +616,12 @@ def main() -> None:
     )
 
     import jax
+
+    # Honor an explicit JAX_PLATFORMS pin in the parent too: the env
+    # var alone loses to ambient accelerator-plugin discovery.
+    _pin = os.environ.get("JAX_PLATFORMS")
+    if _pin:
+        jax.config.update("jax_platforms", _pin)
 
     register_configs, pipeline = bench_register_plane()
     configs = register_configs + [

@@ -349,11 +349,17 @@ class QueueChecker:
 # -- total queue -------------------------------------------------------------
 
 
-def expand_queue_drain_ops(h: History) -> History:
+def expand_queue_drain_ops(h: History):
     """Expand ok :drain ops (value = collection) into per-element
-    :dequeue invoke/ok pairs.
+    :dequeue invoke/ok pairs. Returns (history, crashed_drains):
+    a crashed (:info) drain may have consumed elements whose
+    observations are lost — it contributes nothing, and the count lets
+    the checker degrade would-be "lost" verdicts to unknown instead of
+    manufacturing false data loss (real wire clients crash drains on
+    transport errors after jobs were acked, protocols/clients.py).
     Ref: jepsen/src/jepsen/checker.clj:536-569."""
     out: List[Op] = []
+    crashed = 0
     for op in h.ops:
         if op.f != "drain":
             out.append(op)
@@ -363,9 +369,9 @@ def expand_queue_drain_ops(h: History) -> History:
             for el in op.value or ():
                 out.append(op.with_(type=INVOKE, f="dequeue", value=None))
                 out.append(op.with_(type=OK, f="dequeue", value=el))
-        else:
-            raise ValueError(f"can't handle crashed drain op {op!r}")
-    return History(out, indexed=True)
+        else:  # crashed drain: indeterminate consumption
+            crashed += 1
+    return History(out, indexed=True), crashed
 
 
 class TotalQueueChecker:
@@ -375,7 +381,9 @@ class TotalQueueChecker:
     """
 
     def check(self, test, history, opts=None) -> dict:
-        h = expand_queue_drain_ops(_as_history(history))
+        h, crashed_drains = expand_queue_drain_ops(
+            _as_history(history)
+        )
         interner = _Interner()
         att_l, enq_l, deq_l = [], [], []
         for op in h.ops:
@@ -407,8 +415,19 @@ class TotalQueueChecker:
                 if c > 0
             }
 
+        # Apparent losses with a crashed drain in play are
+        # indeterminate: the elements may sit in the drain that never
+        # reported (UNKNOWN, the validity lattice's middle).
+        clean = int(lost.sum()) == 0 and int(unexpected.sum()) == 0
+        if not clean and int(lost.sum()) > 0 and crashed_drains:
+            valid = (
+                False if int(unexpected.sum()) > 0 else "unknown"
+            )
+        else:
+            valid = clean
         return {
-            "valid?": int(lost.sum()) == 0 and int(unexpected.sum()) == 0,
+            "valid?": valid,
+            "crashed-drain-count": crashed_drains,
             "attempt-count": int(att.sum()),
             "acknowledged-count": int(enq.sum()),
             "ok-count": int(ok.sum()),

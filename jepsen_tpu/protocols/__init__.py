@@ -10,4 +10,5 @@ in-memory clients.
 from jepsen_tpu.protocols.resp import (  # noqa: F401
     RespConnection,
     RespError,
+    RespProtocolError,
 )

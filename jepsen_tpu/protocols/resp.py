@@ -17,7 +17,12 @@ CRLF = b"\r\n"
 
 
 class RespError(Exception):
-    """A server -ERR reply."""
+    """A server -ERR reply (a complete, in-sync frame)."""
+
+
+class RespProtocolError(ConnectionError):
+    """The reply stream is desynced or unintelligible — transport
+    family: callers must drop the connection, never complete :fail."""
 
 
 def encode_command(*args) -> bytes:
@@ -83,12 +88,17 @@ class RespConnection:
         return data
 
     def _read_reply(self) -> Any:
+        """Parse one reply. Error frames come back as RespError VALUES
+        (not raised): a nested error inside an array must not abort
+        the parse mid-frame — the remaining elements would stay unread
+        and desync every later reply. call() raises top-level errors.
+        """
         line = self._read_line()
         kind, rest = line[:1], line[1:]
         if kind == b"+":
             return rest.decode()
         if kind == b"-":
-            raise RespError(rest.decode())
+            return RespError(rest.decode())
         if kind == b":":
             return int(rest)
         if kind == b"$":
@@ -105,8 +115,12 @@ class RespConnection:
             if n < 0:
                 return None
             return [self._read_reply() for _ in range(n)]
-        raise RespError(f"unknown RESP type byte {kind!r}")
+        # Unknown type byte: the stream position is lost for good.
+        raise RespProtocolError(f"unknown RESP type byte {kind!r}")
 
     def call(self, *args) -> Any:
         self.sock.sendall(encode_command(*args))
-        return self._read_reply()
+        reply = self._read_reply()
+        if isinstance(reply, RespError):
+            raise reply
+        return reply

@@ -520,3 +520,44 @@ def test_set_full_blocked_matches_unblocked(monkeypatch):
     monkeypatch.setattr(red, "_SETFULL_BLOCK_CELLS", 64)  # force blocks
     blocked = SetFullChecker().check({}, h)
     assert full == blocked
+
+
+def test_total_queue_crashed_drain_degrades_to_unknown():
+    """A crashed (:info) drain may have consumed elements: apparent
+    losses become unknown, not false — but clean histories stay valid
+    and unexpected elements stay invalid."""
+    from jepsen_tpu.history.history import History
+    from jepsen_tpu.history.ops import info_op, invoke_op, ok_op
+
+    base = [
+        invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+        invoke_op(1, "enqueue", 2), ok_op(1, "enqueue", 2),
+        invoke_op(0, "dequeue"), ok_op(0, "dequeue", 1),
+    ]
+    # Element 2 unobserved + a crashed drain -> unknown, not False.
+    h = History(base + [
+        invoke_op(1, "drain"), info_op(1, "drain"),
+    ])
+    r = TotalQueueChecker().check({}, h)
+    assert r["valid?"] == "unknown"
+    assert r["crashed-drain-count"] == 1 and r["lost-count"] == 1
+
+    # Without the crashed drain the same loss is definite.
+    r = TotalQueueChecker().check({}, History(base))
+    assert r["valid?"] is False and r["lost-count"] == 1
+
+    # Crashed drain but nothing lost: still valid.
+    h = History(base + [
+        invoke_op(1, "dequeue"), ok_op(1, "dequeue", 2),
+        invoke_op(1, "drain"), info_op(1, "drain"),
+    ])
+    r = TotalQueueChecker().check({}, h)
+    assert r["valid?"] is True
+
+    # Unexpected elements dominate: False even with a crashed drain.
+    h = History(base + [
+        invoke_op(1, "dequeue"), ok_op(1, "dequeue", 99),
+        invoke_op(1, "drain"), info_op(1, "drain"),
+    ])
+    r = TotalQueueChecker().check({}, h)
+    assert r["valid?"] is False

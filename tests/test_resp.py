@@ -293,3 +293,27 @@ def test_drain_with_consumed_jobs_goes_info_not_fail(server):
     with pytest.raises(ConnectionResetError):
         c.invoke(test, invoke_op(0, "drain"))  # job 1 was consumed
     c.close(test)
+
+
+def test_protocol_desync_is_transport_error(server):
+    """An unintelligible frame must surface as a ConnectionError
+    (transport family -> :info + stream drop), never as a definite
+    RespError (:fail)."""
+    from jepsen_tpu.protocols.resp import RespProtocolError
+
+    c = RespConnection("127.0.0.1", server.port)
+    # Poison the buffer with a frame type the parser doesn't know.
+    c._buf = b">3\r\nunsolicited\r\n"
+    with pytest.raises(RespProtocolError) as exc:
+        c.call("GET", "k")
+    assert isinstance(exc.value, ConnectionError)
+    c.close()
+    # ...and through the client: desync on a write crashes to :info
+    # (raises), never :fail.
+    test = {"nodes": ["127.0.0.1"]}
+    rc = RespRegisterClient(port=server.port).open(test, "127.0.0.1")
+    rc._conn._buf = b">1\r\nx\r\n"
+    with pytest.raises(ConnectionError):
+        rc.invoke(test, invoke_op(0, "write", 1))
+    assert rc._conn is None  # stream dropped
+    rc.close(test)
