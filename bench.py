@@ -266,11 +266,24 @@ def bench_register_plane():
     )
 
     # Pipelined: one dispatch train, one sync, whole register plane.
-    pipe_wall, pipe_ok = _time(
-        lambda: _register_plane_pipelined(etcd, zk, ns), reps=3
-    )
-    if pipe_ok is not None:
-        assert pipe_ok, "pipelined verdicts diverged"
+    # Best-effort: a failure here must never kill the bench (the solo
+    # measurements above are the record).
+    try:
+        pipe_wall, pipe_ok = _time(
+            lambda: _register_plane_pipelined(etcd, zk, ns), reps=3
+        )
+        if pipe_ok is False:
+            print(
+                "WARNING: pipelined register-plane verdicts diverged; "
+                "discarding the pipelined number", file=sys.stderr,
+            )
+            pipe_ok = None
+    except Exception as e:  # noqa: BLE001 - report, don't die
+        print(
+            f"WARNING: pipelined register plane failed: {e!r}",
+            file=sys.stderr,
+        )
+        pipe_wall, pipe_ok = float("nan"), None
 
     n_etcd = sum(s.n_ops for s in etcd)
     n_zk = sum(s.n_ops for s in zk)
@@ -320,17 +333,19 @@ def bench_register_plane():
     return configs, pipeline
 
 
-def _register_plane_pipelined(etcd, zk, ns):
+def _register_plane_pipelined(etcd, zk, ns, interpret=False):
     """Dispatch configs 1+2 as ONE batched kernel launch and the north
     star's segment chain right behind it, then sync everything with a
     single collect train. Returns True when all verdicts hold, None
-    when the bitset plan doesn't cover the inputs (non-TPU backend)."""
+    when the bitset plan doesn't cover the inputs (non-TPU backend).
+    interpret=True runs the kernels in Pallas interpret mode so tests
+    exercise this exact path on CPU."""
     from jepsen_tpu.checker import wgl_bitset as bs
     from jepsen_tpu.checker.events import clear_memos, events_to_steps
     from jepsen_tpu.checker.linearizable import _on_tpu
     from jepsen_tpu.checker.models import model as get_model
 
-    if not _on_tpu():
+    if not (_on_tpu() or interpret):
         return None
     m = get_model("cas-register")
     batch = list(etcd) + list(zk)
@@ -345,9 +360,11 @@ def _register_plane_pipelined(etcd, zk, ns):
     steps = [events_to_steps(s, W=bW) for s in batch]
     nsW, nsS = ns_plan
     ns_steps = events_to_steps(ns, W=nsW)
-    h_batch = bs.launch_keys_bitset(steps, model="cas-register", S=S)
+    h_batch = bs.launch_keys_bitset(
+        steps, model="cas-register", S=S, interpret=interpret
+    )
     h_ns = bs.launch_steps_bitset_segmented(
-        ns_steps, model="cas-register", S=nsS
+        ns_steps, model="cas-register", S=nsS, interpret=interpret
     )
     batch_verdicts = bs.collect_keys_bitset(h_batch)
     ns_verdict = bs.collect_steps_bitset_segmented(ns_steps, h_ns)
@@ -682,6 +699,29 @@ def main() -> None:
     for _ in range(3):
         _np.asarray(f(jnp.zeros((8,), jnp.int32)))
     rt = (time.perf_counter() - t0) / 3
+    # Floor-subtracted register-config numbers (VERDICT r3 #3): what
+    # the same solo measurements read once the tunnel's per-sync round
+    # trip is taken out — approximately what untunneled local TPU
+    # hardware pays.
+    for c in register_configs:
+        adj = c["tpu_wall"] - rt
+        if adj <= rt * 0.1:
+            # Wall at/below the floor: subtraction would fabricate a
+            # speedup out of measurement noise.
+            print(
+                f"{c['name']} floor-subtracted: below the sync floor "
+                f"({c['tpu_wall']:.3f}s vs {rt * 1e3:.0f}ms floor) — "
+                "not meaningful",
+                file=sys.stderr,
+            )
+            continue
+        print(
+            f"{c['name']} floor-subtracted: tpu={adj:.3f}s "
+            f"speedup={c['oracle_wall'] / adj:.1f}x "
+            f"vs_python="
+            f"{(c.get('python_wall') or c['oracle_wall']) / adj:.1f}x",
+            file=sys.stderr,
+        )
     print(
         f"devices={jax.devices()} total_ops={total_ops} "
         f"total_tpu={total_tpu:.3f}s geomean_speedup={geomean:.2f} "

@@ -94,3 +94,30 @@ def test_dirty_reads_at_scale():
     out = run({**spec, "concurrency": 4})
     r = out["results"]
     assert r["valid?"] is True and r["read_count"] > 500
+
+
+def test_bench_register_plane_pipelined_interpret():
+    """The bench's pipelined dispatch train (one launch for configs
+    1+2 + the north star's segment chain) — exercised on CPU via
+    Pallas interpret mode so the TPU-only path can't bit-rot between
+    driver runs."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ))
+    import bench
+
+    old = bench.SMOKE
+    bench.SMOKE = True
+    try:
+        etcd = bench._etcd_streams()[:3]
+        zk = bench._zk_streams()[:3]
+        ns = bench._northstar_stream()
+        ok = bench._register_plane_pipelined(
+            etcd, zk, ns, interpret=True
+        )
+        assert ok is True
+    finally:
+        bench.SMOKE = old
