@@ -9,9 +9,12 @@ knossos.wgl" comparison point: knossos's wgl search is sequential per
 key, so a single-core C++ run bounds what a JVM core can do; multi-key
 parallelism is handled separately by ``wgl_oracle.check_streams``).
 
-Scope: register-family + mutex models, windows <= 64 slots. Outside
-that envelope the functions return None and callers fall back to the
-Python oracle (unbounded masks, arbitrary hashable state).
+Scope: models whose state fits an int32 — register family, mutex,
+and the packed count-vector queue (whose packed envelope is enforced
+HERE, not just in the ladder: an out-of-envelope code would drive the
+C++ step into undefined-behavior shifts) — with windows <= 64 slots.
+Outside the envelope the functions return None and callers fall back
+to the Python oracle (unbounded masks, arbitrary hashable state).
 """
 
 from __future__ import annotations
@@ -26,7 +29,12 @@ from jepsen_tpu.checker.events import EventStream, crashed_invokes
 from jepsen_tpu.checker.models import Model, model as get_model
 from jepsen_tpu.utils.cc import build_shared
 
-_MODEL_IDS = {"cas-register": 0, "register": 1, "mutex": 2}
+_MODEL_IDS = {
+    "cas-register": 0,
+    "register": 1,
+    "mutex": 2,
+    "unordered-queue-packed": 3,
+}
 
 _SRC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -75,6 +83,14 @@ def check_events_native(
     model_id = _MODEL_IDS.get(m.name)
     if model_id is None or events.window > 64:
         return None
+    if m.name == "unordered-queue-packed":
+        # Enforce the packing envelope here too: a value code >= 7
+        # would shift past the int32 nibble space in the C++ step
+        # (undefined behavior -> silently wrong verdicts).
+        from jepsen_tpu.checker.models import packed_queue_envelope
+
+        if not packed_queue_envelope(events):
+            return None
     lib = _load()
     if lib is None:
         return None

@@ -245,9 +245,10 @@ def check_events_fast(
     prune: bool = True,
 ):
     """Strongest host-side oracle for this stream: the native C++ rung
-    (wgl_native) when the stream fits its envelope (register-family /
-    mutex, window <= 64), else the Python frontier search. Same
-    algorithm either way — verdicts are interchangeable.
+    (wgl_native) when the stream fits its envelope (int32-state models
+    — register family, mutex, packed queue — window <= 64), else the
+    Python frontier search. Same algorithm either way — verdicts are
+    interchangeable.
 
     Returns what check_events returns, plus — when return_stats — the
     deciding rung under ``stats["oracle"]`` ("native" | "python").

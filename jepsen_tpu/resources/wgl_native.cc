@@ -10,10 +10,12 @@
 // (:info) ops stay open forever, tamed by the same exactness-preserving
 // crashed-bit dominance pruning the Python oracle uses.
 //
-// Scope: register-family models (state fits an int32) and mutex, with
-// windows up to 64 open slots (one machine word of mask). Wider windows
-// and rich-state models (unordered-queue) return UNSUPPORTED and the
-// caller falls back to the Python oracle, whose masks are unbounded.
+// Scope: models whose state fits an int32 — register family, mutex,
+// and the packed count-vector queue (models.py unordered-queue-packed)
+// — with windows up to 64 open slots (one machine word of mask). Wider
+// windows and rich-state models (tuple-multiset unordered-queue)
+// return UNSUPPORTED and the caller falls back to the Python oracle,
+// whose masks and states are unbounded.
 //
 // This file is both a product component (a fast host-side rung between
 // the TPU engines and the Python oracle in the escalation ladder) and
@@ -36,9 +38,11 @@ constexpr int EV_NOP = 2;
 constexpr int MODEL_CAS_REGISTER = 0;
 constexpr int MODEL_REGISTER = 1;
 constexpr int MODEL_MUTEX = 2;
+constexpr int MODEL_QUEUE_PACKED = 3;
 
 constexpr int F_READ = 0, F_WRITE = 1, F_CAS = 2;
 constexpr int F_ACQUIRE = 0, F_RELEASE = 1;
+constexpr int F_ENQ = 0, F_DEQ = 1;
 
 struct Config {
   int32_t state;
@@ -82,9 +86,18 @@ inline bool step(int model, int32_t state, int32_t f, int32_t a,
       if (f == F_READ) { *out = state; return state == a; }
       if (f == F_WRITE) { *out = a; return true; }
       return false;  // cas is outside the model: never linearizes
-    default:  // MODEL_MUTEX
+    case MODEL_MUTEX:
       if (f == F_ACQUIRE) { *out = 1; return state == 0; }
       /* F_RELEASE */ *out = 0; return state == 1;
+    default: {  // MODEL_QUEUE_PACKED: count-vector in nibbles
+      if (a < 0) { *out = state; return false; }  // NIL never linearizes
+      int shift = 4 * a;
+      if (f == F_ENQ) { *out = state + (1 << shift); return true; }
+      /* F_DEQ */
+      if ((state >> shift) & 15) { *out = state - (1 << shift); return true; }
+      *out = state;
+      return false;
+    }
   }
 }
 
@@ -181,7 +194,7 @@ long long wgl_native_check(const int32_t* kind, const int32_t* slot,
                            long long* out_stats) {
   if (window > 64 || window < 0) return -2;
   if (model != MODEL_CAS_REGISTER && model != MODEL_REGISTER &&
-      model != MODEL_MUTEX)
+      model != MODEL_MUTEX && model != MODEL_QUEUE_PACKED)
     return -2;
 
   Frontier frontier;

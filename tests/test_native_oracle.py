@@ -7,6 +7,7 @@ escalation rung in the product ladder and the bench's strong CPU
 baseline, so any divergence would poison verdicts AND numbers.
 """
 
+import os
 import random
 
 import pytest
@@ -135,3 +136,45 @@ def test_check_streams_matches_serial():
     # 1-core host exercises the fork/pickle plumbing).
     got2, meta2 = check_streams(streams, processes=2)
     assert got2 == wants
+
+
+def test_native_packed_queue_parity():
+    """The native oracle's packed-queue model must match the Python
+    packed oracle (and hence the tuple oracle) on queue histories."""
+    from test_queue_device import _corrupt, gen_queue_history
+
+    n_invalid = 0
+    for seed in range(30):
+        rng = random.Random(7100 + seed)
+        h = gen_queue_history(rng, n_ops=24)
+        if seed % 2:
+            h = _corrupt(h, rng)
+        ev = history_to_events(h, model="unordered-queue")
+        want = check_events(ev, model="unordered-queue-packed")
+        got = wgl_native.check_events_native(
+            ev, model="unordered-queue-packed"
+        )
+        assert got == want, f"seed {seed}"
+        if not want:
+            n_invalid += 1
+    assert n_invalid > 3
+    # The tuple-multiset model stays outside the native envelope.
+    assert wgl_native.check_events_native(
+        ev, model="unordered-queue"
+    ) is None
+    # Out-of-envelope PACKED calls must decline too (a >= 7 value
+    # code would be undefined-behavior shifts in the C++ step).
+    ops = []
+    for i in range(10):
+        ops.append(invoke_op(0, "enqueue", i))
+        ops.append(ok_op(0, "enqueue", i))
+    ops.append(invoke_op(0, "dequeue", 99))
+    ops.append(ok_op(0, "dequeue", 99))
+    wide = history_to_events(History(ops), model="unordered-queue")
+    assert wgl_native.check_events_native(
+        wide, model="unordered-queue-packed"
+    ) is None
+    valid, stats = check_events_fast(
+        wide, model="unordered-queue-packed", return_stats=True
+    )
+    assert stats["oracle"] == "python" and valid is False
