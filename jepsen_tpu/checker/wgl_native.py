@@ -26,7 +26,11 @@ from typing import Any, Optional, Tuple, Union
 import numpy as np
 
 from jepsen_tpu.checker.events import EventStream, crashed_invokes
-from jepsen_tpu.checker.models import Model, model as get_model
+from jepsen_tpu.checker.models import (
+    Model,
+    model as get_model,
+    packed_queue_envelope,
+)
 from jepsen_tpu.utils.cc import build_shared
 
 _MODEL_IDS = {
@@ -83,14 +87,13 @@ def check_events_native(
     model_id = _MODEL_IDS.get(m.name)
     if model_id is None or events.window > 64:
         return None
-    if m.name == "unordered-queue-packed":
+    if m.name == "unordered-queue-packed" and not packed_queue_envelope(
+        events
+    ):
         # Enforce the packing envelope here too: a value code >= 7
         # would shift past the int32 nibble space in the C++ step
         # (undefined behavior -> silently wrong verdicts).
-        from jepsen_tpu.checker.models import packed_queue_envelope
-
-        if not packed_queue_envelope(events):
-            return None
+        return None
     lib = _load()
     if lib is None:
         return None

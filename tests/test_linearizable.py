@@ -477,3 +477,39 @@ def test_independent_results_carry_engine_stats(tmp_path):
     assert sum(es["engines"].values()) == 2  # one verdict per key
     assert es["taints"] == 0
     assert sum(es["windows"].values()) == 2
+
+
+def test_k_frontier_envelope_17_to_40_differential():
+    """The 17-128 window region (past the exact bitset envelope, on
+    the K-frontier rungs) — differential against the oracle on
+    crash-heavy histories whose windows land in it, valid and
+    corrupted. VERDICT r3 #8 called this envelope's behavior
+    anecdotal; this pins it with measurements."""
+    windows_seen = []
+    n_invalid = 0
+    for seed in range(8):
+        rng = random.Random(5500 + seed)
+        # Seed crashed writes to push the window past 16, then layer
+        # a normal workload on top.
+        pre = []
+        n_crashed = 17 + (seed % 3) * 8  # 17, 25, 33
+        for i in range(n_crashed):
+            pre.append(invoke_op(500 + i, "write", i % 5))
+            pre.append(info_op(500 + i, "write", i % 5))
+        body = gen_history(rng, n_ops=40, n_procs=4, p_crash=0.02)
+        h = H(*(pre + list(body.ops)))
+        if seed % 2:
+            h = corrupt(h, rng)
+        ev = history_to_events(h)
+        windows_seen.append(ev.window)
+        assert ev.window > 16, ev.window
+        want = check_events(ev)
+        got = check_events_bucketed(ev)
+        assert got["valid?"] == want, (
+            f"seed {seed} window {ev.window}: {got}"
+        )
+        assert got["method"].startswith(("tpu-wgl", "cpu-oracle"))
+        if not want:
+            n_invalid += 1
+    assert max(windows_seen) >= 33
+    assert n_invalid >= 2

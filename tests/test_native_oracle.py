@@ -7,7 +7,6 @@ escalation rung in the product ladder and the bench's strong CPU
 baseline, so any divergence would poison verdicts AND numbers.
 """
 
-import os
 import random
 
 import pytest
