@@ -82,3 +82,46 @@ def test_clock_plot(tmp_path):
     assert r["valid?"] is True
     svg = open(r["file"]).read()
     assert "n1" in svg and "n2" in svg and "polyline" in svg
+
+
+def test_timeline_rich_rendering(tmp_path):
+    """Nemesis bands, tooltips with durations, legend, and the op cap
+    banner (timeline.clj's shading/tooltip roles)."""
+    import random
+
+    from jepsen_tpu.checker.timeline import render
+    from jepsen_tpu.history.history import History
+    from jepsen_tpu.history.ops import info_op, invoke_op, ok_op
+
+    ops = []
+    t = 0
+    for i in range(6):
+        o = invoke_op(i % 2, "write", i)
+        o = o.with_(time=t)
+        ops.append(o)
+        c = ok_op(i % 2, "write", i).with_(time=t + 1_000_000)
+        ops.append(c)
+        t += 2_000_000
+    ops.append(invoke_op("nemesis", "start").with_(time=1_000_000))
+    ops.append(info_op("nemesis", "start").with_(time=1_500_000))
+    ops.append(invoke_op("nemesis", "stop").with_(time=6_000_000))
+    ops.append(info_op("nemesis", "stop").with_(time=6_500_000))
+    doc = render({"name": "rich"}, History(ops))
+    assert doc.count('class="nem"') == 1  # ONE merged band per window
+    assert "nemesis active" in doc        # legend entry
+    assert "ms" in doc and "t+" in doc    # rich tooltip
+    assert "showing the first" not in doc
+
+    # An op with no completion shows a lower bound, not a fabricated
+    # duration.
+    open_ops = ops + [invoke_op(1, "read").with_(time=7_000_000)]
+    doc = render({"name": "open"}, History(open_ops))
+    assert "(unresolved)" in doc and "&gt;=" in doc
+
+    # Cap banner on oversized histories.
+    big = []
+    for i in range(30):
+        big.append(invoke_op(0, "write", i).with_(time=i * 10))
+        big.append(ok_op(0, "write", i).with_(time=i * 10 + 5))
+    doc = render({"name": "big"}, History(big), max_ops=10)
+    assert "showing the first 10 of 30 operations" in doc
