@@ -568,12 +568,16 @@ def _engine_stats(register_configs):
     return stats
 
 
-def _device_health_gate(timeout_s: float = 180.0) -> None:
+def _device_health_gate(
+    timeout_s: float = 180.0, attempts: int = 3, spacing_s: float = 60.0
+) -> None:
     """Fail fast with a diagnostic if the accelerator is unreachable
     (the axon tunnel can wedge behind an orphaned server-side compile;
     without this gate the bench hangs indefinitely instead of telling
     the operator what's wrong). Runs the probe in a subprocess — a
-    wedged device call cannot be interrupted in-process."""
+    wedged device call cannot be interrupted in-process. Retries a few
+    times: the driver's round-end run is a one-shot chance, and a
+    flapping tunnel deserves more than one look."""
     import subprocess
 
     # The probe must honor an explicit JAX_PLATFORMS pin via config —
@@ -587,21 +591,52 @@ def _device_health_gate(timeout_s: float = 180.0) -> None:
         "np.asarray(jax.jit(lambda x: x + 1)(jnp.zeros(4))); "
         "print('healthy')"
     )
-    try:
-        p = subprocess.run(
-            [sys.executable, "-c", probe],
-            capture_output=True, text=True, timeout=timeout_s,
+    tail = ""
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(spacing_s)
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            if "healthy" in (p.stdout or ""):
+                return
+            # Fast non-healthy exit: deterministic breakage (broken
+            # install, plugin crash) — retrying cannot help.
+            tail = (p.stderr or "")[-500:]
+            print(
+                f"health gate failed without timing out: {tail}",
+                file=sys.stderr,
+            )
+            break
+        except subprocess.TimeoutExpired:
+            # The wedge signature — the one failure worth retrying.
+            tail = (
+                f"device probe did not answer within {timeout_s:.0f}s"
+            )
+        print(
+            f"health gate attempt {attempt + 1}/{attempts} failed: "
+            f"{tail}",
+            file=sys.stderr,
         )
-        if "healthy" in (p.stdout or ""):
-            return
-        tail = (p.stderr or "")[-500:]
-    except subprocess.TimeoutExpired:
-        tail = f"device probe did not answer within {timeout_s:.0f}s"
     print(
         "bench aborted: accelerator unreachable (wedged tunnel / "
         f"terminal-side compile?): {tail}",
         file=sys.stderr,
     )
+    # Structured evidence for the driver/judge: an explicit null
+    # measurement (cannot be mistaken for a perf number) naming the
+    # failure, instead of bare rc=3 with empty stdout.
+    print(json.dumps({
+        "metric": "ops_verified_per_sec",
+        "value": None,
+        "unit": "ops/s",
+        "vs_baseline": None,
+        "error": "accelerator unreachable (wedged tunnel): " + tail,
+        "probe_attempts": attempts,
+        "probe_timeout_s": timeout_s,
+    }))
     raise SystemExit(3)
 
 
