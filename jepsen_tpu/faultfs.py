@@ -239,15 +239,24 @@ class FuseFaultFSNemesis(Nemesis):
     Op values may instead be {node: spec} dicts to target subsets.
     """
 
-    def __init__(self, backing: str, mountpoint: str):
+    def __init__(self, backing: str, mountpoint: str,
+                 install: bool = True):
         self.backing = backing
         self.mountpoint = mountpoint
+        #: False when the DB's setup already mounted the filesystem
+        #: (required when the daemon must open its data dir THROUGH
+        #: the mount from the start — a later mount would hide the
+        #: files its open fds still point at).
+        self.install = install
 
     def setup(self, test) -> "FuseFaultFSNemesis":
-        def fn(node, sess):
-            install_fuse(sess, self.backing, self.mountpoint)
-
-        on_nodes(test, fn)
+        if self.install:
+            on_nodes(
+                test,
+                lambda node, sess: install_fuse(
+                    sess, self.backing, self.mountpoint
+                ),
+            )
         return self
 
     def invoke(self, test, op: Op) -> Op:
@@ -286,6 +295,6 @@ class FuseFaultFSNemesis(Nemesis):
 
 
 def fuse_faultfs_nemesis(
-    backing: str, mountpoint: str
+    backing: str, mountpoint: str, install: bool = True
 ) -> FuseFaultFSNemesis:
-    return FuseFaultFSNemesis(backing, mountpoint)
+    return FuseFaultFSNemesis(backing, mountpoint, install=install)

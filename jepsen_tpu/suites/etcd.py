@@ -60,10 +60,22 @@ def initial_cluster(test) -> str:
 
 
 class EtcdDB(DB):
-    """Install + run etcd per node (etcd.clj:52-86)."""
+    """Install + run etcd per node (etcd.clj:52-86).
 
-    def __init__(self, version: str = VERSION):
+    disk_faults=True mounts the FUSE fault filesystem over the data
+    dir BEFORE etcd starts (etcd is a statically-linked Go binary —
+    only a mount-level interposer can afflict it, and it must open
+    its data dir through the mount from the first write), and points
+    etcd's --data-dir at the mountpoint. Pair with
+    faultfs.fuse_faultfs_nemesis(..., install=False)."""
+
+    DATA_BACKING = f"{DIR}/data-backing"
+    DATA_MOUNT = f"{DIR}/data"
+
+    def __init__(self, version: str = VERSION,
+                 disk_faults: bool = False):
         self.version = version
+        self.disk_faults = disk_faults
 
     def setup(self, test, node, session):
         url = (
@@ -71,6 +83,12 @@ class EtcdDB(DB):
             f"{self.version}/etcd-{self.version}-linux-amd64.tar.gz"
         )
         install_archive(session, url, DIR)
+        extra = []
+        if self.disk_faults:
+            from jepsen_tpu.faultfs import install_fuse
+
+            install_fuse(session, self.DATA_BACKING, self.DATA_MOUNT)
+            extra = ["--data-dir", self.DATA_MOUNT]
         start_daemon(
             session,
             BINARY,
@@ -81,6 +99,7 @@ class EtcdDB(DB):
             "--initial-cluster-state", "new",
             "--initial-advertise-peer-urls", peer_url(node),
             "--initial-cluster", initial_cluster(test),
+            *extra,
             pidfile=PIDFILE,
             logfile=LOGFILE,
             chdir=DIR,
@@ -91,6 +110,10 @@ class EtcdDB(DB):
 
     def teardown(self, test, node, session):
         stop_daemon(session, PIDFILE)
+        if self.disk_faults:
+            from jepsen_tpu.faultfs import fuse_unmount
+
+            fuse_unmount(session, self.DATA_MOUNT)
         session.exec("rm", "-rf", DIR, sudo=True)
 
     def log_files(self, test, node):
@@ -173,6 +196,7 @@ def etcd_test(opts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     per_key_limit = opts.pop("per_key_limit", 300)
     stagger_s = opts.pop("stagger", 1 / 30)
     nemesis_interval = opts.pop("nemesis_interval", 10)
+    nemesis_kind = opts.pop("nemesis", "partition")
 
     from jepsen_tpu.workloads.register import op_mix
 
@@ -183,21 +207,46 @@ def etcd_test(opts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
             per_key_limit, gen.stagger(stagger_s, op_mix(rng), rng=rng)
         ),
     )
-    nemesis_gen = gen.nemesis(
-        gen.repeat(lambda: [
+    if nemesis_kind == "disk":
+        # Mount-level disk faults (charybdefs.clj's role): the DB
+        # mounts the fault fs before etcd starts; the nemesis only
+        # flips faults (1%-flaky on start — the reference's
+        # break-one-percent — clear on stop).
+        from jepsen_tpu.faultfs import FuseFaultFSNemesis
+
+        db = EtcdDB(disk_faults=True)
+        nemesis = FuseFaultFSNemesis(
+            EtcdDB.DATA_BACKING, EtcdDB.DATA_MOUNT, install=False
+        )
+        nemesis_ops = [
+            gen.sleep(nemesis_interval),
+            gen.once({"f": "flaky", "value": 1}),
+            gen.sleep(nemesis_interval),
+            gen.once({"f": "clear"}),
+        ]
+    elif nemesis_kind == "partition":
+        db = EtcdDB()
+        nemesis = nemlib.partition_random_halves(rng=rng)
+        nemesis_ops = [
             gen.sleep(nemesis_interval),
             gen.once({"f": "start"}),
             gen.sleep(nemesis_interval),
             gen.once({"f": "stop"}),
-        ])
-    )
+        ]
+    else:
+        raise ValueError(
+            f"unknown nemesis kind {nemesis_kind!r}; "
+            "have: partition, disk"
+        )
+
+    nemesis_gen = gen.nemesis(gen.repeat(lambda: list(nemesis_ops)))
     test: Dict[str, Any] = {
         "name": "etcd",
         "os": Debian(),
-        "db": EtcdDB(),
+        "db": db,
         "client": EtcdClient(),
         "net": netlib.IptablesNet(),
-        "nemesis": nemlib.partition_random_halves(rng=rng),
+        "nemesis": nemesis,
         # The nemesis cycle is infinite, so the whole generator is
         # bounded by the time limit (etcd.clj:170-176).
         "generator": gen.time_limit(

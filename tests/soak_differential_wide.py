@@ -32,10 +32,11 @@ def gen_mutex(rng, n_ops, n_procs):
                 op = invoke_op(p, "release"); held[0] = False
             elif not held[0]:
                 op = invoke_op(p, "acquire"); held[0] = True
+            elif rng.random() < 0.4:
+                # Doomed double-acquire: emitted anyway — invalid if it
+                # completes :ok while the first holder never released.
+                op = invoke_op(p, "acquire")
             else:
-                op = invoke_op(p, "acquire")  # will be invalid if acked
-                # don't actually take it; mark as doomed by not flipping
-                # -> instead skip: choose release-less path
                 free.append(p); continue
             ops.append(op); open_by[p] = op; emitted += 1
         else:

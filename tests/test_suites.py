@@ -249,3 +249,47 @@ def test_consul_db_commands():
     assert any("-bootstrap-expect=3" in c for c in c1)
     assert not any("-retry-join" in c for c in c1)
     assert any("-retry-join=n1" in c for c in c2)
+
+
+def test_etcd_disk_fault_mode_mounts_before_start():
+    """nemesis='disk' (VERDICT r3 #4): the DB mounts the FUSE fault
+    filesystem BEFORE etcd starts, etcd's --data-dir goes through the
+    mount, and the nemesis flips faults via the control file without
+    re-installing."""
+    from jepsen_tpu.faultfs import CTL_NAME, FuseFaultFSNemesis
+    from jepsen_tpu.history.ops import invoke_op
+
+    remote = DummyRemote()
+    test = {"nodes": ["n1"], "remote": remote, "db_start_wait": 0}
+    t = etcd.etcd_test({"nemesis": "disk"})
+    db, nem = t["db"], t["nemesis"]
+    assert isinstance(nem, FuseFaultFSNemesis) and not nem.install
+    sess = sessions_for(test)
+    db.setup(test, "n1", sess["n1"])
+    cmds = remote.commands("n1")
+    mount_i = next(
+        i for i, c in enumerate(cmds) if "fusefaultfs /opt/etcd" in c
+    )
+    start_i = next(
+        i for i, c in enumerate(cmds) if "etcd.pid" in c
+    )
+    assert mount_i < start_i  # mounted before the daemon opens it
+    assert any("--data-dir /opt/etcd/data" in c for c in cmds)
+
+    # Nemesis setup must NOT re-install (the DB owns the mount)...
+    n_before = len(remote.commands("n1"))
+    nem.setup(test)
+    assert len(remote.commands("n1")) == n_before
+    # ...and fault ops write the control file.
+    out = nem.invoke(test, invoke_op(0, "flaky", 1))
+    assert out.value == {"n1": "flaky all 100"}
+    assert any(
+        CTL_NAME in c for c in remote.commands("n1")[n_before:]
+    )
+    out = nem.invoke(test, invoke_op(0, "clear"))
+    assert out.value == {"n1": "clear"}
+
+    db.teardown(test, "n1", sess["n1"])
+    assert any(
+        "umount /opt/etcd/data" in c for c in remote.commands("n1")
+    )
