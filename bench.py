@@ -245,7 +245,7 @@ def bench_register_plane():
     # Warmups (compile + shape caches).
     r_etcd = check_keys(etcd)
     r_zk = check_keys(zk)
-    r_ns = check_events_bucketed(ns)
+    r_ns = check_events_bucketed(ns, race=False)
     for r, want in zip(r_etcd + r_zk + [r_ns],
                        b_etcd["verdicts"] + b_zk["verdicts"]
                        + b_ns["verdicts"]):
@@ -257,11 +257,13 @@ def bench_register_plane():
     )
     zk_wall, r_zk = _time(_uncached(lambda: check_keys(zk), zk), reps=3)
     ns_wall, r_ns = _time(
-        _uncached(lambda: check_events_bucketed(ns), [ns]), reps=3
+        _uncached(lambda: check_events_bucketed(ns, race=False), [ns]),
+        reps=3,
     )
     assert ns_wall < 60, f"north-star budget blown: {ns_wall:.1f}s"
     single_wall, r1 = _time(
-        _uncached(lambda: check_events_bucketed(etcd[1]), etcd[1:2]),
+        _uncached(lambda: check_events_bucketed(etcd[1], race=False),
+                  etcd[1:2]),
         reps=3,
     )
     print(
@@ -789,6 +791,40 @@ def main() -> None:
                     if pipeline["available"]
                     else None
                 ),
+                "sync_floor_ms": round(rt * 1e3, 1),
+                # Per-config record (VERDICT r4 Weak #7): solo wall,
+                # strongest-CPU baseline, and the floor-subtracted
+                # wall (null when the solo wall sits at the sync
+                # floor — subtraction would fabricate a speedup),
+                # so round-over-round comparisons survive
+                # tunnel-weather changes without digging in stderr.
+                "configs": [
+                    {
+                        "name": c["name"],
+                        "n_ops": c["n_ops"],
+                        "tpu_wall_s": round(c["tpu_wall"], 4),
+                        "baseline_wall_s": round(c["oracle_wall"], 4),
+                        "python_wall_s": (
+                            round(c["python_wall"], 4)
+                            if c.get("python_wall") is not None
+                            else None
+                        ),
+                        "native_wall_s": (
+                            round(c["native_wall"], 4)
+                            if c.get("native_wall") is not None
+                            else None
+                        ),
+                        "speedup": round(
+                            c["oracle_wall"] / c["tpu_wall"], 2
+                        ),
+                        "floor_subtracted_wall_s": (
+                            round(c["tpu_wall"] - rt, 4)
+                            if c["tpu_wall"] - rt > rt * 0.1
+                            else None
+                        ),
+                    }
+                    for c in configs
+                ],
                 "engine_stats": stats,
             }
         )

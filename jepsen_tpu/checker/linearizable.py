@@ -201,15 +201,172 @@ def _oracle_decide(events: EventStream, model):
     return valid, stats, failure
 
 
+#: largest stream the decision race will hand to the native-oracle
+#: thread: above this the TPU always wins and the loser thread would
+#: burn the host core long after the verdict (no cancellation seam in
+#: a blocking ctypes call).
+RACE_MAX_OPS = 20_000
+
+
+class _NativeRacer:
+    """Background native-oracle run for the competition race
+    (knossos's `competition` role, checker.clj:128-144): the TPU
+    kernel and the C++ oracle start together, the first definite
+    verdict wins, and when both land by decision time the verdicts
+    cross-check — production differential coverage for free.
+
+    The ctypes call releases the GIL, so the oracle genuinely overlaps
+    the tunnel round trip; on a busy single-core host callers start
+    the racer AFTER host-side prep so the threads don't contend."""
+
+    def __init__(self, events: EventStream, model):
+        import threading
+
+        self.result: Optional[tuple] = None
+        self.error: Optional[BaseException] = None
+        ev, mdl = events, model
+
+        def run():
+            try:
+                from jepsen_tpu.checker.wgl_native import (
+                    check_events_native,
+                )
+
+                self.result = check_events_native(
+                    ev, model=mdl, return_stats=True
+                )
+            except BaseException as e:  # noqa: BLE001 - report later
+                self.error = e
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="wgl-native-race"
+        )
+        self._thread.start()
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def join(self, timeout: float) -> None:
+        self._thread.join(timeout)
+
+
+def _race_eligible(events: EventStream, m) -> bool:
+    from jepsen_tpu.checker import wgl_native
+
+    return (
+        events.n_ops <= RACE_MAX_OPS
+        and events.window <= 64
+        and m.name in wgl_native._MODEL_IDS
+        and wgl_native.available()
+    )
+
+
+#: cumulative race outcomes for observability (bench engine_stats and
+#: run epitaphs read this; reset_race_stats() for tests)
+RACE_STATS = {
+    "tpu_wins": 0,
+    "native_wins": 0,
+    "crosschecked": 0,
+    "mismatches": 0,
+}
+
+
+def reset_race_stats() -> None:
+    for k in RACE_STATS:
+        RACE_STATS[k] = 0
+
+
+def _tpu_handle_ready(handle) -> bool:
+    outs = handle[0]
+    try:
+        return all(o.is_ready() for o in outs)
+    except AttributeError:  # pragma: no cover - very old jax
+        return True
+
+
+def _native_win_verdict(events, racer, model, escalations=0):
+    """Assemble the verdict dict for a native race win, or None if the
+    racer crashed/declined (its envelope check returned None)."""
+    if racer.error is not None or racer.result is None:
+        return None
+    valid, stats = racer.result
+    RACE_STATS["native_wins"] += 1
+    out = {
+        "valid?": valid,
+        "method": "cpu-oracle-native",
+        "race_winner": "native",
+        "frontier_k": None,
+        "escalations": escalations,
+    }
+    if not valid:
+        out["failed_op_index"] = stats.get("failed_op_index")
+        # The native oracle carries no death-config material;
+        # failure analysis is rare and worth a Python re-run
+        # (the reference budgets hours for report writing).
+        _, py_stats, failure = _oracle_decide(events, model)
+        if failure is not None:
+            out["failure"] = failure
+    return out
+
+
+def _race_decide(events, bsteps, handle, racer, model):
+    """Poll until either engine produces a verdict. Returns the
+    assembled verdict dict when the NATIVE side wins, or None when the
+    TPU result is ready first (the caller collects it normally). A
+    native win leaves the device work to finish harmlessly in the
+    background; a TPU win leaves the oracle thread to run out (bounded
+    by the RACE_MAX_OPS gate)."""
+    import time as _time
+
+    while True:
+        if _tpu_handle_ready(handle):
+            return None
+        if racer.done():
+            out = _native_win_verdict(events, racer, model)
+            if out is None:
+                return None  # oracle crashed/declined: TPU decides
+            return out
+        _time.sleep(0.001)
+
+
+def _race_crosscheck(racer, tpu_alive: bool) -> None:
+    """TPU won the race: if the oracle lands within a short grace,
+    cross-check the verdicts — free production differential coverage.
+    A mismatch means an engine bug; it is logged loudly and counted
+    (the differential soaks treat any mismatch as a failure)."""
+    RACE_STATS["tpu_wins"] += 1
+    racer.join(0.05)
+    if not racer.done() or racer.error or racer.result is None:
+        return
+    RACE_STATS["crosschecked"] += 1
+    native_valid = racer.result[0]
+    if bool(native_valid) != bool(tpu_alive):
+        RACE_STATS["mismatches"] += 1
+        import logging
+
+        logging.getLogger("jepsen_tpu.checker").critical(
+            "RACE MISMATCH: tpu-wgl-bitset=%s cpu-oracle-native=%s — "
+            "engine bug; file with the stream's seed/material",
+            tpu_alive, native_valid,
+        )
+
+
 def check_events_bucketed(
     events: EventStream,
     model: str = "cas-register",
     k_ladder=K_LADDER,
+    race: Optional[bool] = None,
 ) -> dict:
     """Definite linearizability verdict for an event stream.
 
     Returns {"valid?": bool, "method": "tpu-wgl-bitset"|"tpu-wgl"|
              "cpu-oracle-native"|"cpu-oracle-python", "frontier_k": K or None, "escalations": int}.
+
+    race: run the native C++ oracle concurrently with the TPU kernel
+    and take the first verdict (knossos competition, checker.clj:
+    128-144). Default: on for streams the native envelope covers and
+    small enough that the losing thread's overrun is bounded
+    (RACE_MAX_OPS). Pass False for pure-TPU measurement runs.
     """
     from jepsen_tpu.checker.models import model as get_model
 
@@ -221,10 +378,12 @@ def check_events_bucketed(
     # definite — no escalation ladder, no oracle fallback (wgl_bitset
     # module docstring). taint is impossible by construction; if it ever
     # fires, fall through to the capacity-ladder paths below.
+    racer = None  # one native racer serves bitset AND ladder tiers
     plan = _bitset_plan(events, m) if _on_tpu() else None
     if plan is not None:
         from jepsen_tpu.checker.wgl_bitset import (
-            check_steps_bitset_segmented,
+            collect_steps_bitset_segmented,
+            launch_steps_bitset_segmented,
         )
 
         bW, S = plan
@@ -232,9 +391,23 @@ def check_events_bucketed(
         # Segment-aware: the prefix before crashes widen the window
         # runs on the narrow (16x cheaper) kernel; padding/bucketing
         # happens per segment inside.
-        alive, taint, died = check_steps_bitset_segmented(
-            bsteps, model=model, S=S
+        handle = launch_steps_bitset_segmented(bsteps, model=model, S=S)
+        if race is None:
+            race = _race_eligible(events, m)
+        if race:
+            # Start AFTER the dispatch: host prep is done, the core is
+            # otherwise idle while the device scans / the tunnel syncs.
+            racer = _NativeRacer(events, model)
+            verdict = _race_decide(
+                events, bsteps, handle, racer, model
+            )
+            if verdict is not None:
+                return verdict
+        alive, taint, died = collect_steps_bitset_segmented(
+            bsteps, handle
         )
+        if racer is not None:
+            _race_crosscheck(racer, alive)
         if not taint:
             out = {
                 "valid?": alive,
@@ -324,8 +497,27 @@ def check_events_bucketed(
     # remains the fallback for wide windows, big-K rungs that exceed the
     # kernel's VMEM budget, CPU meshes, and shard_map.
     on_tpu = on_tpu_now
+    # The K-ladder is where escalation-heavy histories burn time, so
+    # the competition race matters most here (checker.clj:128-144):
+    # the native oracle runs through every rung, and its verdict is
+    # taken at the next rung boundary if it lands first.
+    if race is None:
+        race = on_tpu_now and _race_eligible(events, m)
+    if race and racer is None:
+        # (an already-running racer from the bitset branch's taint
+        # fall-through is reused, not duplicated)
+        racer = _NativeRacer(events, model)
+    elif not race:
+        racer = None
     escalations = 0
     for K in k_ladder:
+        if racer is not None and racer.done():
+            out = _native_win_verdict(
+                events, racer, model, escalations
+            )
+            if out is not None:
+                return out
+            racer = None  # oracle crashed/declined: ladder decides
         if on_tpu and _pallas_ok(K, W, steps.NW):
             from jepsen_tpu.checker.wgl_pallas import check_steps_pallas
 
@@ -349,8 +541,18 @@ def check_events_bucketed(
             }
             if not alive:
                 out["failed_op_index"] = died
+            if racer is not None:
+                _race_crosscheck(racer, alive)
             return out
         escalations += 1
+    if racer is not None:
+        # Every rung overflowed and the racer is already computing
+        # exactly the oracle verdict we need: wait for it rather than
+        # starting a second native run.
+        racer.join(3600.0)
+        out = _native_win_verdict(events, racer, model, escalations)
+        if out is not None:
+            return out
     valid, stats, failure = _oracle_decide(events, model)
     return _oracle_verdict(
         valid, stats, failure,

@@ -35,11 +35,23 @@ index: keep masks containing the returning bit, shift them back
 
 Soundness: every set bit is a config reached by a legal linearization
 chain that passed every prior RETURN filter (monotone ORs only add
-reachable configs; the round bound W+2 exceeds the longest possible
-chain, and non-convergence — impossible by that argument — still
-reports as taint rather than trusting the verdict). alive=False is
-therefore always definite: the empty frontier means NO linearization
-order exists, and the step's op_index is reported as the failing op.
+reachable configs). Two execution tiers share this invariant:
+
+- FAST tier (default): FAST_ROUNDS unrolled closure rounds per step,
+  no convergence checks — chains deeper than the budget leave the
+  frontier UNDER-closed, i.e. a subset of the true config set.
+  alive=True is still definite (any surviving config is a witness);
+  alive=False is provisional, and the driver escalates it.
+- EXACT tier: adaptive while_loop to a verified fixpoint (round bound
+  W+2 exceeds the longest possible chain; non-convergence — impossible
+  by that argument — still reports as taint rather than trusting the
+  verdict). Both verdicts definite; used to decide fast-tier deaths,
+  so a reported failure's op_index is always the exact tier's.
+
+The tiering exists because the while_loop machinery costs ~1.8 us/step
+of scalar-core serialization on v5e while the unrolled rounds cost
+~0.2 us at W=12 — and valid histories (the overwhelmingly common case)
+never leave the fast tier.
 
 Reference role: the knossos search behind
 jepsen/src/jepsen/checker.clj:127-158, as an exact accelerator-resident
@@ -68,25 +80,37 @@ OUT_COLS = 8
 #: state travels as the fr_in frontier, not per-step meta)
 META_COLS = 4
 
-#: return-steps per grid iteration (amortizes per-iteration block DMA)
-STEP_BLOCK = 8
+#: return-steps per grid iteration (amortizes per-iteration block DMA
+#: and grid sequencing; B=16 measured ~15% faster than 8 on the
+#: north-star scan, B=32 no better and twice the compile time)
+STEP_BLOCK = 16
 
 
-def step_block(W: int) -> int:
+def step_block(W: int, interpret: bool = False) -> int:
     """Substeps per grid iteration: 1 at W=20 — the unrolled kernel
     body over 32768-lane tensors is otherwise too much program for
-    Mosaic to compile in reasonable time."""
+    Mosaic to compile in reasonable time. Interpret mode (CPU tests)
+    uses a small block: the fully unrolled fast-tier body at B=16
+    builds an HLO graph deep enough to crash XLA:CPU's compiler
+    (observed segfault in backend_compile_and_load); Mosaic on real
+    TPU handles the full block."""
+    if interpret:
+        return 4
     return STEP_BLOCK if W <= 16 else 1
 
 #: mask-word lane floor: smaller windows still use full vector lanes
 MIN_WORDS = 128
 
-#: supported window buckets (2^W/32 words: 128 and 2048 lanes).
-#: W=20 was attempted and abandoned: Mosaic does not finish compiling
-#: the closure kernel over 32768-lane tensors in any reasonable time
-#: (>10 min even with a 1-substep grid), so windows past 16 route to
-#: the K-frontier ladder instead.
-W_BUCKETS = (12, 16)
+#: supported window buckets (2^W/32 words: 128..2048 lanes). Per-step
+#: vector cost scales with 2^W once the per-step machinery is paid, so
+#: every width 12..16 is its own bucket and the segment planner moves
+#:  between them as the live window fluctuates (measured on v5e: the
+#: leading-prefix-only W12/W16 split left 25k+ of the north star's
+#: steps running 16x too wide). W=20 was attempted and abandoned:
+#: Mosaic does not finish compiling the closure kernel over 32768-lane
+#: tensors in any reasonable time (>10 min even with a 1-substep
+#: grid), so windows past 16 route to the K-frontier ladder instead.
+W_BUCKETS = (12, 13, 14, 15, 16)
 
 #: state-row cap (VMEM: 32 x 2048 x 4 B = 256 KB at W=16)
 MAX_ROWS = 32
@@ -173,11 +197,20 @@ def _remove_bit_dyn(fr, r, lane, M: int):
     return jnp.where(r < 5, intra, word)
 
 
-def _make_kernel(model_name: str, S: int, W: int):
+#: fast-tier fixed closure rounds (round 0 counts): covers chain
+#: depth <= FAST_ROUNDS. Deeper chains under-close the frontier, which
+#: is SOUND for alive verdicts (subset of the true closure — every set
+#: bit is still a legal linearization witness) and merely triggers the
+#: exact-kernel re-run when the fast tier reports a death.
+FAST_ROUNDS = 3
+
+
+def _make_kernel(model_name: str, S: int, W: int, exact: bool = True,
+                 interpret: bool = False):
     bitset_slot = get_model(model_name).bitset_slot_jax
     assert bitset_slot is not None, model_name
     M = max((1 << W) // 32, MIN_WORDS)
-    B = step_block(W)
+    B = step_block(W, interpret)
 
     def kernel(win_ref, meta_ref, fr_in_ref, out_ref, fr_out_ref,
                f_ref, snap_ref):
@@ -212,6 +245,33 @@ def _make_kernel(model_name: str, S: int, W: int):
             # frontier artifact into fr_out
             fr_out_ref[0] = f_ref[:]
 
+    def _round_body(f, b, win_ref, fresh, r, lane1, rows):
+        """One closure round over all W slots, branch-free: measured
+        on v5e, every pl.when/loop branch costs ~200 ns of scalar-core
+        serialization, and a per-slot pl.when design spent ~50
+        branches (~10 us) per step with the vector units idle —
+        per-step wall was FLAT in M. Slot gating is therefore
+        arithmetic (a gated-out slot contributes zero to the OR)."""
+        for w in range(W):
+            occw = win_ref[0, b, 0, w]
+            freshw = (fresh >> w) & 1
+            gate = jnp.where(r == 0, freshw, occw)
+            fw = win_ref[0, b, 1, w]
+            aw = win_ref[0, b, 2, w]
+            bw = win_ref[0, b, 3, w]
+            is_union, src_row, dst_row, valid = bitset_slot(fw, aw, bw)
+            one_row = jnp.sum(
+                jnp.where(rows == src_row, f, 0),
+                axis=0,
+                keepdims=True,
+            )
+            union = _or_rows(f, S)
+            src = jnp.where(is_union, union, one_row)
+            src = jnp.where(valid & (gate == 1), src, 0)
+            add = jnp.where(rows == dst_row, _add_bit(src, w, lane1), 0)
+            f = f | add
+        return f
+
     def _substep(win_ref, meta_ref, out_ref, fr_out_ref, f_ref,
                  snap_ref, b):
         slot_r = meta_ref[0, b, 0]
@@ -221,50 +281,46 @@ def _make_kernel(model_name: str, S: int, W: int):
 
         fresh = meta_ref[0, b, 3]
 
-        @pl.when((alive == 1) & (live == 1))
-        def _step():
+        # Round 0 expands ONLY freshly invoked slots: the frontier
+        # arrives closed under every other open op (a RETURN filter
+        # preserves closure — events.ReturnSteps.fresh), so further
+        # rounds run only to chase chains round 0 enabled. Steps with
+        # no fresh invokes skip the closure entirely.
+        #
+        # EXACT tier: adaptive while_loop to a verified fixpoint —
+        # definite verdicts both ways, but the loop machinery costs
+        # ~1.8 us/step of scalar-core serialization (measured v5e).
+        #
+        # FAST tier: FAST_ROUNDS unrolled rounds, no convergence
+        # check. Chains deeper than FAST_ROUNDS leave the frontier
+        # UNDER-closed — a subset of the true config set, since
+        # monotone ORs only ever add legally-reached configs. alive=1
+        # is therefore still a definite VALID (any surviving config is
+        # a witness); alive=0 is NOT definite (the dropped configs
+        # might have survived), so the driver re-runs the dying
+        # segment on the exact tier before reporting invalid.
+        @pl.when((alive == 1) & (live == 1) & (fresh != 0))
+        def _rounds():
             lane1 = lax.broadcasted_iota(jnp.int32, (1, M), 1)
             rows = lax.broadcasted_iota(jnp.int32, (S, 1), 0)
 
-            # Rounds mutate the frontier ref in place so each slot's
-            # vector work sits under a pl.when on its SMEM gate
-            # scalar — a real branch, so gated-out slots cost nothing.
-            # Round 0 expands ONLY freshly invoked slots: the frontier
-            # arrives closed under every other open op (a RETURN
-            # filter preserves closure — events.ReturnSteps.fresh), so
-            # when round 0 adds nothing the step is already done, and
-            # a full round runs only to chase chains it enabled.
+            if not exact:
+                f = f_ref[:]
+                for r in range(FAST_ROUNDS):
+                    f = _round_body(
+                        f, b, win_ref, fresh, jnp.int32(r), lane1, rows
+                    )
+                f_ref[:] = f
+                return
+
             def round_fn(st):
                 _, r = st
                 snap_ref[:] = f_ref[:]
-                for w in range(W):
-                    occw = win_ref[0, b, 0, w]
-                    freshw = (fresh >> w) & 1
-                    gate = jnp.where(r == 0, freshw, occw)
-
-                    @pl.when(gate == 1)
-                    def _slot(w=w):
-                        fw = win_ref[0, b, 1, w]
-                        aw = win_ref[0, b, 2, w]
-                        bw = win_ref[0, b, 3, w]
-                        is_union, src_row, dst_row, valid = bitset_slot(
-                            fw, aw, bw
-                        )
-                        fr = f_ref[:]
-                        one_row = jnp.sum(
-                            jnp.where(rows == src_row, fr, 0),
-                            axis=0,
-                            keepdims=True,
-                        )
-                        union = _or_rows(fr, S)
-                        src = jnp.where(is_union, union, one_row)
-                        src = jnp.where(valid, src, 0)
-                        add = jnp.where(
-                            rows == dst_row, _add_bit(src, w, lane1), 0
-                        )
-                        f_ref[:] = fr | add
-
-                changed = jnp.any(f_ref[:] != snap_ref[:])
+                f = _round_body(
+                    f_ref[:], b, win_ref, fresh, r, lane1, rows
+                )
+                f_ref[:] = f
+                changed = jnp.any(f != snap_ref[:])
                 return changed, r + 1
 
             def cond_fn(st):
@@ -277,15 +333,19 @@ def _make_kernel(model_name: str, S: int, W: int):
             out_ref[0, 0, 3] = out_ref[0, 0, 3] + nr
             out_ref[0, 0, 4] = jnp.maximum(out_ref[0, 0, 4], nr)
 
+            @pl.when(changed)
+            def _taint():  # round bound hit (see module docstring)
+                out_ref[0, 0, 1] = 1
+
+        @pl.when((alive == 1) & (live == 1))
+        def _ret():
+            lane1 = lax.broadcasted_iota(jnp.int32, (1, M), 1)
+
             # RETURN filter: keep configs with the returning op
             # linearized, clear its bit (frees the slot).
             pre = f_ref[:]
             fr = _remove_bit_dyn(pre, slot_r, lane1, M)
             f_ref[:] = fr
-
-            @pl.when(changed)
-            def _taint():  # round bound hit (see module docstring)
-                out_ref[0, 0, 1] = 1
 
             @pl.when(jnp.logical_not(jnp.any(fr != 0)))
             def _died():
@@ -294,7 +354,8 @@ def _make_kernel(model_name: str, S: int, W: int):
                 # Failure artifact: the competing configs the filter
                 # killed — every state/mask the search still considered
                 # possible when the returning op proved impossible
-                # (checker.clj:146-154's reporting role).
+                # (checker.clj:146-154's reporting role). On the fast
+                # tier this is provisional — the exact re-run decides.
                 fr_out_ref[0] = pre
 
     return kernel, M
@@ -315,22 +376,34 @@ def init_frontier(init_state, S: int, W: int) -> np.ndarray:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("model_name", "S", "W", "interpret")
+    jax.jit,
+    static_argnames=("model_name", "S", "W", "interpret", "exact"),
 )
-def _bitset_scan(win, meta, fr_in, model_name, S, W, interpret=False):
-    """Batched scan: win [n_keys, n, 4, W] int8 (occ/f/a/b — int8 on
-    the wire to quarter the host->device transfer, widened on device),
-    meta [n_keys, n, META_COLS] int32, fr_in [n_keys, S, M] starting
-    frontier -> (out [n_keys, 1, OUT_COLS], fr_out [n_keys, S, M]
-    final frontier). Keys form the outer grid dimension — one launch,
-    one host sync per batch; the frontier in/out pair lets segments
-    with different W chain back-to-back on device (W12 -> W16 embeds
-    the mask space as the first 128 words)."""
-    n_keys, n = win.shape[0], win.shape[1]
-    B = step_block(W)
+def _bitset_scan(
+    win, meta, fr_in, model_name, S, W, interpret=False, exact=True
+):
+    """Batched scan: win [n_keys, n*4*W] int8 FLAT (occ/f/a/b — int8
+    on the wire to quarter the transfer, and 1-D per key because TPU
+    tiled layouts pad the two minor dims to (32, 128): a [n, 4, W]
+    int8 host array would inflate ~85x during the host-side relayout,
+    which measured as >1 s of single-core repack for a 100k-op
+    stream), meta [n_keys, n*META_COLS] int32 flat likewise, fr_in
+    [n_keys, S, M] starting frontier -> (out [n_keys, 1, OUT_COLS],
+    fr_out [n_keys, S, M] final frontier). The reshape to [n, 4, W] /
+    [n, META_COLS] happens HERE, on device, where it's a cheap HBM
+    relayout. Keys form the outer grid dimension — one launch, one
+    host sync per batch; the frontier in/out pair lets segments with
+    different W chain back-to-back on device (W12 -> W16 embeds the
+    mask space as the first 128 words)."""
+    n_keys = win.shape[0]
+    n = win.shape[1] // (4 * W)
+    B = step_block(W, interpret)
     assert n % B == 0, f"steps {n} not a multiple of {B}"
-    kernel, M = _make_kernel(model_name, S, W)
-    win = win.astype(jnp.int32)
+    kernel, M = _make_kernel(
+        model_name, S, W, exact=exact, interpret=interpret
+    )
+    win = win.reshape(n_keys, n, 4, W).astype(jnp.int32)
+    meta = meta.reshape(n_keys, n, META_COLS)
     return pl.pallas_call(
         kernel,
         grid=(n_keys, n // B),
@@ -371,9 +444,11 @@ def _bitset_scan(win, meta, fr_in, model_name, S, W, interpret=False):
 
 
 def pack_steps(steps: ReturnSteps):
-    """Host-side packing: [n, 4, W] int8 window scalars (occ/f/a/b —
-    codes are < MAX_ROWS so int8 quarters the tunnel upload) + [n, 4]
-    int32 per-step meta, padded to a STEP_BLOCK multiple."""
+    """Host-side packing: FLAT [n*4*W] int8 window scalars (occ/f/a/b
+    — codes are < MAX_ROWS so int8 quarters the tunnel upload, and
+    flat because multi-dim int8 host arrays pay a ruinous tiled-layout
+    repack on transfer; see _bitset_scan) + flat [n*META_COLS] int32
+    per-step meta, padded to a STEP_BLOCK multiple."""
     B = STEP_BLOCK
     if len(steps) % B or not len(steps):
         steps = steps.padded(max(((len(steps) + B - 1) // B) * B, B))
@@ -392,7 +467,7 @@ def pack_steps(steps: ReturnSteps):
     win = np.stack(
         [steps.occ, steps.f, steps.a, steps.b], axis=1
     ).astype(np.int8)
-    return win, meta
+    return win.reshape(-1), meta.reshape(-1)
 
 
 def _out_to_verdicts(out: np.ndarray) -> List[Tuple[bool, bool, int]]:
@@ -406,10 +481,16 @@ def check_steps_bitset(
     model: str = "cas-register",
     S: int = 8,
     interpret: bool = False,
+    exact: bool = False,
 ) -> Tuple[bool, bool, int]:
-    """Single-key exact check: (alive, taint, died_op_index). taint is
-    the overflow analog in the verdict contract and is always False in
+    """Single-key check: (alive, taint, died_op_index). taint is the
+    overflow analog in the verdict contract and is always False in
     practice (see module docstring).
+
+    Two-tier: the fast fixed-round kernel decides alive verdicts
+    (sound — its frontier is a subset of the true closure), and a
+    fast-tier death re-runs on the exact while-loop kernel, whose
+    verdicts are definite both ways. exact=True skips the fast tier.
 
     The packed device args memoize on the steps object (same discipline
     as wgl_pallas: ReturnSteps are treated as immutable once checked —
@@ -420,16 +501,21 @@ def check_steps_bitset(
         return jnp.asarray(win[None]), jnp.asarray(meta[None])
 
     args = memo_on(steps, "_bitset_args", None, pack_dev)
+    name = model if isinstance(model, str) else model.name
     fr0 = jnp.asarray(init_frontier(steps.init_state, S, steps.W)[None])
-    out, fr = _bitset_scan(
-        *args,
-        fr0,
-        model_name=model if isinstance(model, str) else model.name,
-        S=S,
-        W=steps.W,
-        interpret=interpret,
-    )
+
+    def scan(exact_flag):
+        return _bitset_scan(
+            *args, fr0, model_name=name, S=S, W=steps.W,
+            interpret=interpret, exact=exact_flag,
+        )
+
+    out, fr = scan(exact)
     verdict = _out_to_verdicts(np.asarray(out))[0]
+    if not verdict[0] and not exact:
+        # fast-tier death is provisional (under-closure): exact decides
+        out, fr = scan(True)
+        verdict = _out_to_verdicts(np.asarray(out))[0]
     if not verdict[0]:
         # death artifact: the pre-filter frontier (decode_frontier)
         steps._death_frontier = np.asarray(fr)[0]
@@ -482,22 +568,109 @@ def _embed_frontier(fr_lo, S, M_hi):
     return jnp.pad(fr_lo, ((0, 0), (0, 0), (0, pad)))
 
 
-def plan_segments(steps: ReturnSteps) -> List[Tuple[int, int, int]]:
-    """[(start, end, W)] segments: for each narrower bucket, the
-    leading run of steps whose windows fit it forms a cheaper segment
-    (per-op cost scales with 2^W). A segment must be worth its launch
-    (>= max(n/8, STEP_BLOCK) steps)."""
+def _reshape_frontier(fr, S: int, M_to: int):
+    """Move a [1, S, M] device frontier between mask spaces. Widening
+    is a lane pad (_embed_frontier). NARROWING is a lane slice, legal
+    exactly when every mask bit >= W_to is zero — guaranteed by the
+    planner: a segment runs at W_to only when no slot >= W_to is
+    occupied anywhere in it, and an unoccupied slot's mask bit is
+    provably zero (a set bit means linearized-but-not-returned, which
+    is an occupied slot)."""
+    M_from = fr.shape[-1]
+    if M_to > M_from:
+        return _embed_frontier(fr, S, M_to)
+    if M_to < M_from:
+        return fr[:, :, :M_to]
+    return fr
+
+
+def required_buckets(steps: ReturnSteps) -> np.ndarray:
+    """Per-step minimum W bucket: the smallest W_BUCKETS entry
+    covering every occupied slot and the returning slot at that step
+    (slots are 0-based, so slot k needs W >= k+1)."""
     n = len(steps)
+    Wf = steps.occ.shape[1]
+    occ = steps.occ.astype(bool)
+    maxslot = np.where(
+        occ.any(axis=1), Wf - 1 - np.argmax(occ[:, ::-1], axis=1), -1
+    )
+    need = np.maximum(maxslot, steps.slot) + 1
+    wreq = np.full(n, W_BUCKETS[-1], np.int64)
+    for b in reversed(W_BUCKETS):
+        wreq[need <= b] = b
+    return wreq
+
+
+#: relative per-step cost of a segment at bucket W: a fixed machinery
+#: term plus vector work proportional to the mask words (measured on
+#: v5e: ~2 us machinery, ~0.2 us of round work per 128 words)
+def _seg_cost(w: int) -> float:
+    return 2.0 + 0.2 * (bitset_words(w) / MIN_WORDS)
+
+
+def plan_segments(
+    steps: ReturnSteps, min_len: int | None = None
+) -> List[Tuple[int, int, int]]:
+    """[(start, end, W)] segments over the WHOLE stream: each step
+    runs at the narrowest bucket its window fits (per-op vector cost
+    scales with 2^W), with short runs absorbed into a neighbor so
+    every segment is worth its kernel launch. Unlike a
+    leading-prefix-only split, narrow valleys AFTER the window has
+    once widened still run narrow — the frontier legally narrows at
+    the boundary because no occupied slot reaches the sliced-off
+    lanes (see _reshape_frontier)."""
+    n = len(steps)
+    if n == 0 or steps.W <= W_BUCKETS[0]:
+        return [(0, n, steps.W)]
+    if min_len is None:
+        # every launch costs host dispatch; bound the segment count
+        min_len = max(512, n // 48)
+    wreq = np.minimum(required_buckets(steps), steps.W)
+    # Chunk-max planning (O(n) vectorized — the per-step requirement
+    # flips thousands of times, so exact RLE merging is quadratic in
+    # runs and measured >1 s on a 100k stream): fixed chunks take the
+    # max requirement inside them, then equal neighbors coalesce. A
+    # width spike widens only its own chunk.
+    chunk = max(min_len // 2, STEP_BLOCK)
+    n_chunks = (n + chunk - 1) // chunk
+    padded = np.full(n_chunks * chunk, W_BUCKETS[0], wreq.dtype)
+    padded[:n] = wreq
+    cmax = padded.reshape(n_chunks, chunk).max(axis=1)
+    runs: List[List[int]] = []
+    for ci, v in enumerate(cmax):
+        ln = min(chunk, n - ci * chunk)
+        if runs and runs[-1][0] == int(v):
+            runs[-1][1] += ln
+        else:
+            runs.append([int(v), ln])
+    # absorb any still-short runs into their cheaper neighbor
+    i = 0
+    while len(runs) > 1 and i < len(runs):
+        if runs[i][1] >= min_len:
+            i += 1
+            continue
+        cands = []
+        for j in (i - 1, i + 1):
+            if 0 <= j < len(runs):
+                vi, li = runs[i]
+                vj, lj = runs[j]
+                vm = max(vi, vj)
+                added = li * (_seg_cost(vm) - _seg_cost(vi)) + lj * (
+                    _seg_cost(vm) - _seg_cost(vj)
+                )
+                cands.append((added, j))
+        _, j = min(cands)
+        lo, hi = min(i, j), max(i, j)
+        runs[lo] = [
+            max(runs[lo][0], runs[hi][0]), runs[lo][1] + runs[hi][1]
+        ]
+        del runs[hi]
+        i = max(lo - 1, 0)
     segs: List[Tuple[int, int, int]] = []
     start = 0
-    for b in W_BUCKETS:
-        if b >= steps.W:
-            break
-        k = split_point(steps, b)
-        if k - start >= max(n // 8, STEP_BLOCK):
-            segs.append((start, k, b))
-            start = k
-    segs.append((start, n, steps.W))
+    for v, ln in runs:
+        segs.append((start, start + ln, v))
+        start += ln
     return segs
 
 
@@ -506,17 +679,22 @@ def launch_steps_bitset_segmented(
     model: str = "cas-register",
     S: int = 8,
     interpret: bool = False,
+    exact: bool = False,
 ):
     """Dispatch the multi-segment scan WITHOUT the final host fetch:
     every segment chains through the frontier in/out pair on device
-    (the embed is a lane pad — a narrow mask space is a lane prefix of
-    the wide one), and the returned handle carries each segment's
-    device verdict + death frontier for a later collect."""
+    (widening is a lane pad, narrowing a lane slice — a narrow mask
+    space is a lane prefix of the wide one), and the returned handle
+    carries each segment's device verdict + death frontier + input
+    frontier for a later collect. By default segments run on the FAST
+    fixed-round kernel; the collect escalates a death to the exact
+    kernel from the dying segment's input frontier onward."""
     segs = plan_segments(steps)
     name = model if isinstance(model, str) else model.name
     fr = jnp.asarray(init_frontier(steps.init_state, S, segs[0][2])[None])
     outs = []
     frs = []
+    fr_ins = []
 
     def packed(start, end, W):
         sub = _slice_steps(steps, start, end, W)
@@ -531,30 +709,58 @@ def launch_steps_bitset_segmented(
             steps, "_seg_args", (start, end, W),
             lambda s=start, e=end, w=W: packed(s, e, w),
         )
-        fr = _embed_frontier(fr, S, bitset_words(W))
+        fr = _reshape_frontier(fr, S, bitset_words(W))
+        fr_ins.append(fr)
         out, fr = _bitset_scan(
             *args, fr,
             model_name=name, S=S, W=W, interpret=interpret,
+            exact=exact,
         )
         outs.append(out)
         frs.append(fr)
-    return outs, frs
+    return outs, frs, (segs, fr_ins, name, S, interpret, exact)
 
 
 def collect_steps_bitset_segmented(
     steps: ReturnSteps, handle
 ) -> Tuple[bool, bool, int]:
     """Block on a launch_steps_bitset_segmented handle: one device_get
-    for every segment's verdict; the first death wins."""
-    outs, frs = handle
+    for every segment's verdict; the first death wins. A death on the
+    fast tier is provisional (its under-closed frontier is a subset of
+    the true one — see _make_kernel), so the dying segment and
+    everything after it re-run on the exact kernel, restarted from the
+    dying segment's recorded input frontier."""
+    outs, frs, (segs, fr_ins, name, S, interpret, exact) = handle
     fetched = jax.device_get(tuple(outs))
     taint = False
-    for o, dead_fr in zip(fetched, frs):
+    for k, (o, dead_fr) in enumerate(zip(fetched, frs)):
         alive, t, died = _out_to_verdicts(np.asarray(o))[0]
         taint = taint or t
         if not alive:
-            steps._death_frontier = np.asarray(dead_fr)[0]
-            return False, taint, died
+            if exact:
+                steps._death_frontier = np.asarray(dead_fr)[0]
+                return False, taint, died
+            # exact re-run from the dying segment's input frontier
+            fr = fr_ins[k]
+            for start, end, W in segs[k:]:
+                args = memo_on(steps, "_seg_args", (start, end, W),
+                               lambda: None)
+                assert args is not None  # packed during launch
+                fr = _reshape_frontier(fr, S, bitset_words(W))
+                out2, fr2 = _bitset_scan(
+                    *args, fr,
+                    model_name=name, S=S, W=W, interpret=interpret,
+                    exact=True,
+                )
+                alive2, t2, died2 = _out_to_verdicts(
+                    np.asarray(out2)
+                )[0]
+                taint = taint or t2
+                if not alive2:
+                    steps._death_frontier = np.asarray(fr2)[0]
+                    return False, taint, died2
+                fr = fr2
+            return True, taint, -1
     return True, taint, -1
 
 
@@ -672,12 +878,15 @@ def launch_keys_bitset(
     model: str = "cas-register",
     S: int = 8,
     interpret: bool = False,
+    exact: bool = False,
 ):
     """Dispatch the batched per-key scan WITHOUT a host sync: returns
-    the device verdict array. Collecting later (collect_keys_bitset)
-    lets callers pipeline several batches' device work behind one
-    another — the tunnel's round-trip floor is paid once per pipeline,
-    not once per batch."""
+    a handle with the device verdict array. Collecting later
+    (collect_keys_bitset) lets callers pipeline several batches'
+    device work behind one another — the tunnel's round-trip floor is
+    paid once per pipeline, not once per batch. Keys run on the fast
+    fixed-round kernel by default; the collect re-checks any key the
+    fast tier reported dead on the exact kernel (see _make_kernel)."""
     n = bucket(max(max(len(st) for st in steps_list), 1), 64)
     name = model if isinstance(model, str) else model.name
     W = steps_list[0].W
@@ -689,21 +898,35 @@ def launch_keys_bitset(
     fr0 = jnp.asarray(np.stack([
         init_frontier(st.init_state, S, W) for st in steps_list
     ]))
+    win_j = jnp.asarray(np.stack(wins))
+    meta_j = jnp.asarray(np.stack(metas))
     out, _ = _bitset_scan(
-        jnp.asarray(np.stack(wins)),
-        jnp.asarray(np.stack(metas)),
-        fr0,
+        win_j, meta_j, fr0,
         model_name=name,
         S=S,
         W=W,
         interpret=interpret,
+        exact=exact,
     )
-    return out
+    return out, (win_j, meta_j, fr0, name, S, W, interpret, exact)
 
 
-def collect_keys_bitset(out) -> List[Tuple[bool, bool, int]]:
-    """Block on a launch_keys_bitset handle and decode verdicts."""
-    return _out_to_verdicts(np.asarray(out))
+def collect_keys_bitset(handle) -> List[Tuple[bool, bool, int]]:
+    """Block on a launch_keys_bitset handle and decode verdicts,
+    re-running the whole batch on the exact kernel if any key's fast
+    verdict was a (provisional) death."""
+    out, (win_j, meta_j, fr0, name, S, W, interpret, exact) = handle
+    verdicts = _out_to_verdicts(np.asarray(out))
+    if exact or all(v[0] for v in verdicts):
+        return verdicts
+    # A fast-tier death is provisional: the exact kernel decides. The
+    # whole batch re-runs in one launch (device args are already
+    # resident; dead keys are rare, so this is the uncommon path).
+    out2, _ = _bitset_scan(
+        win_j, meta_j, fr0,
+        model_name=name, S=S, W=W, interpret=interpret, exact=True,
+    )
+    return _out_to_verdicts(np.asarray(out2))
 
 
 def check_keys_bitset(
@@ -711,11 +934,13 @@ def check_keys_bitset(
     model: str = "cas-register",
     S: int = 8,
     interpret: bool = False,
+    exact: bool = False,
 ) -> List[Tuple[bool, bool, int]]:
-    """Batch of per-key exact checks in ONE kernel launch + host sync.
+    """Batch of per-key checks in ONE kernel launch + host sync (two
+    launches when a fast-tier death escalates to the exact kernel).
     All steps must share W; lengths pad to a power-of-two bucket so one
     compiled kernel serves every batch."""
     return collect_keys_bitset(
         launch_keys_bitset(steps_list, model=model, S=S,
-                           interpret=interpret)
+                           interpret=interpret, exact=exact)
     )

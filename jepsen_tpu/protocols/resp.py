@@ -95,14 +95,28 @@ class RespConnection:
         """
         line = self._read_line()
         kind, rest = line[:1], line[1:]
+
+        def num(raw: bytes) -> int:
+            # A malformed integer/length field means the stream is
+            # desynced — classify as a transport error (like the
+            # unknown-type-byte case below), NOT a bare ValueError,
+            # which clients.py would mistake for an unknown-op
+            # programming error and skip the connection reset.
+            try:
+                return int(raw)
+            except ValueError as e:
+                raise RespProtocolError(
+                    f"malformed RESP number field {raw!r}"
+                ) from e
+
         if kind == b"+":
             return rest.decode()
         if kind == b"-":
             return RespError(rest.decode())
         if kind == b":":
-            return int(rest)
+            return num(rest)
         if kind == b"$":
-            n = int(rest)
+            n = num(rest)
             if n < 0:
                 return None
             data = self._read_exact(n)
@@ -111,7 +125,7 @@ class RespConnection:
             except UnicodeDecodeError:
                 return data
         if kind == b"*":
-            n = int(rest)
+            n = num(rest)
             if n < 0:
                 return None
             return [self._read_reply() for _ in range(n)]

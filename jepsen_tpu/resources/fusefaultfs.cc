@@ -161,11 +161,27 @@ struct Inode {
 };
 
 std::unordered_map<uint64_t, Inode> g_inodes;
-std::unordered_map<uint64_t, uint64_t> g_by_devino;  // dev^ino -> nodeid
+
+// Dedup by the ACTUAL (dev, ino) pair — folding the pair into one
+// 64-bit hash would alias two distinct inodes on collision (wrong
+// attrs/fds, and forget erasing the survivor's mapping); the hash is
+// only the bucket function, equality is exact.
+struct DevIno {
+  uint64_t dev, ino;
+  bool operator==(const DevIno& o) const {
+    return dev == o.dev && ino == o.ino;
+  }
+};
+struct DevInoHash {
+  size_t operator()(const DevIno& k) const {
+    return (size_t)(k.dev * 0x100000001b3ULL ^ k.ino);
+  }
+};
+std::unordered_map<DevIno, uint64_t, DevInoHash> g_by_devino;
 uint64_t g_next_node = 2;  // 1 is the root
 
-uint64_t devino_key(uint64_t dev, uint64_t ino) {
-  return dev * 0x100000001b3ULL ^ ino;
+DevIno devino_key(uint64_t dev, uint64_t ino) {
+  return DevIno{dev, ino};
 }
 
 // Open file handles (fh -> real fd / DIR*).
@@ -238,7 +254,7 @@ bool fill_entry(int parent_path_fd, const char* name,
     close(fd);
     return false;
   }
-  uint64_t key = devino_key(st.st_dev, st.st_ino);
+  DevIno key = devino_key(st.st_dev, st.st_ino);
   auto it = g_by_devino.find(key);
   uint64_t node;
   if (it != g_by_devino.end() && g_inodes.count(it->second)) {

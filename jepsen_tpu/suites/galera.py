@@ -14,6 +14,7 @@ uses the in-memory bank client. Checker: the columnar bank reduction.
 from __future__ import annotations
 
 import random
+import re
 from typing import Any, Dict, Optional
 
 from jepsen_tpu import net as netlib, nemesis as nemlib
@@ -126,6 +127,10 @@ class GaleraBankClient(Client):
                 # whether the second UPDATE applied; an insufficient
                 # balance leaves both rows untouched and must return
                 # :fail rather than record a phantom acked transfer.
+                # Tag the applied-count row so detection keys on the
+                # tag, not on "last non-empty line is a bare digit" —
+                # CLI headers/decorations then can't silently turn an
+                # applied transfer into :fail.
                 out = self._sql(
                     test,
                     "BEGIN; "
@@ -133,13 +138,19 @@ class GaleraBankClient(Client):
                     f"WHERE id = {frm} AND balance >= {amt}; "
                     f"UPDATE accounts SET balance = balance + {amt} "
                     f"WHERE id = {to} AND ROW_COUNT() > 0; "
-                    "SELECT ROW_COUNT(); COMMIT;",
+                    "SELECT CONCAT('applied=', ROW_COUNT()); COMMIT;",
                 )
-                lines = [
-                    ln.strip() for ln in out.splitlines() if ln.strip()
-                ]
-                applied = bool(lines) and lines[-1].isdigit() \
-                    and int(lines[-1]) > 0
+                m = re.search(r"applied=(-?\d+)", out)
+                if m is None:
+                    # No tagged row at all: the statement batch did
+                    # not reach the SELECT — indeterminate (the debit
+                    # may have committed), so a plain exception lets
+                    # the worker record :info, NOT ClientFailed's
+                    # definitely-did-not-happen :fail.
+                    raise RuntimeError(
+                        f"transfer result row missing in {out!r}"
+                    )
+                applied = int(m.group(1)) > 0
                 return op.with_(type="ok" if applied else "fail")
             raise ValueError(f"unknown op f={op.f!r}")
         except ValueError:

@@ -12,9 +12,14 @@ workload, and its os/net flavor; `make_test` assembles the canonical
 test map, and every suite still gets a first-class
 `python -m jepsen_tpu.suites.simple --suite <name>` entry point.
 
-Real-mode clients come from the workload family (SQL/HTTP clients live
-in the sibling suite modules); dummy mode plugs the in-memory clients
-in, as everywhere else.
+Every suite's real mode now speaks the database's ACTUAL protocol via
+`protocols/` (the reference's own discipline — each of its suites
+drives a real driver): RESP for raftis/disque, TreeOps-over-session
+for logcabin, the V0_4/JSON wire protocol for rethinkdb, the binary
+thin-client protocol for ignite, robustsession HTTP/JSON for
+robustirc, mysql/psql CLI batches for mysql-cluster/postgres-rds, and
+OP_QUERY+BSON for mongodb-smartos. Dummy mode plugs the in-memory
+clients in, as everywhere else.
 """
 
 from __future__ import annotations
@@ -31,6 +36,15 @@ from jepsen_tpu.control.util import (
 from jepsen_tpu.protocols.clients import (
     DisqueQueueClient,
     RespRegisterClient,
+)
+from jepsen_tpu.protocols.ignite import IgniteRegisterClient
+from jepsen_tpu.protocols.logcabin import LogCabinRegisterClient
+from jepsen_tpu.protocols.mongo import MongoRegisterClient
+from jepsen_tpu.protocols.robustirc import RobustIrcLogClient
+from jepsen_tpu.protocols.rethinkdb import RethinkRegisterClient
+from jepsen_tpu.protocols.sqlcli import (
+    MysqlCliBankClient,
+    PsqlBankClient,
 )
 from jepsen_tpu.db import DB
 from jepsen_tpu.generator import pure as gen
@@ -107,6 +121,14 @@ def _queue_wl(opts):
     return _queue_workload(opts)
 
 
+def _set_wl(opts):
+    from jepsen_tpu.workloads import set as set_wl
+
+    return set_wl.workload(
+        n_adds=opts.get("ops", 300), rng=opts.get("rng")
+    )
+
+
 #: suite registry: name -> {db: RecipeDB, workloads: {name: factory},
 #: os/net overrides, ref: reference citation}
 SUITES: Dict[str, Dict[str, Any]] = {
@@ -157,6 +179,11 @@ SUITES: Dict[str, Dict[str, Any]] = {
     # (logcabin.clj:23-60)
     "logcabin": {
         "ref": "logcabin/src/jepsen/logcabin.clj",
+        # Real mode drives the TreeOps CLI on the node — the
+        # reference's client IS that binary (logcabin.clj:163-244).
+        "clients": {
+            "register": lambda opts: LogCabinRegisterClient(),
+        },
         "db": RecipeDB(
             setup_cmds=[
                 ["apt-get", "install", "-y", "git-core", "scons",
@@ -180,6 +207,13 @@ SUITES: Dict[str, Dict[str, Any]] = {
     # robustirc: go IRC network with raft (robustirc.clj)
     "robustirc": {
         "ref": "robustirc/src/jepsen/robustirc.clj",
+        # Real mode speaks the robustsession HTTP/JSON API
+        # (protocols/robustirc.py; robustirc.clj:102-135). Set
+        # semantics: an IRC channel is a pub/sub log, so acked posts
+        # must all appear in the final read.
+        "clients": {
+            "set": lambda opts: RobustIrcLogClient(),
+        },
         "db": RecipeDB(
             setup_cmds=[
                 ["sh", "-c",
@@ -198,11 +232,16 @@ SUITES: Dict[str, Dict[str, Any]] = {
             ],
             logs=["/opt/robustirc/robustirc.log"],
         ),
-        "workloads": {"queue": _queue_wl},
+        "workloads": {"set": _set_wl},
     },
     # rethinkdb: apt repo + document-cas (rethinkdb.clj:52-80)
     "rethinkdb": {
         "ref": "rethinkdb/src/jepsen/rethinkdb.clj",
+        # Real mode speaks the V0_4/JSON wire protocol directly
+        # (protocols/rethinkdb.py; document_cas.clj:72-105 semantics).
+        "clients": {
+            "register": lambda opts: RethinkRegisterClient(),
+        },
         "db": RecipeDB(
             setup_cmds=[
                 ["sh", "-c",
@@ -224,6 +263,12 @@ SUITES: Dict[str, Dict[str, Any]] = {
     # ignite: in-memory data grid, register + bank (ignite/*.clj)
     "ignite": {
         "ref": "ignite/src/jepsen/ignite.clj",
+        # Real mode speaks the binary thin-client protocol on :10800
+        # (protocols/ignite.py) — register only; the bank workload
+        # still borrows the generic client (no SQL front end here).
+        "clients": {
+            "register": lambda opts: IgniteRegisterClient(),
+        },
         "db": RecipeDB(
             setup_cmds=[
                 ["sh", "-c",
@@ -245,6 +290,11 @@ SUITES: Dict[str, Dict[str, Any]] = {
     # (mysql_cluster.clj)
     "mysql-cluster": {
         "ref": "mysql-cluster/src/jepsen/mysql_cluster.clj",
+        # Real mode runs the bank as atomic mysql-CLI batches against
+        # the NDB SQL front end (protocols/sqlcli.py).
+        "clients": {
+            "bank": lambda opts: MysqlCliBankClient(),
+        },
         "db": RecipeDB(
             setup_cmds=[
                 ["apt-get", "install", "-y", "mysql-cluster-community-"
@@ -273,6 +323,14 @@ SUITES: Dict[str, Dict[str, Any]] = {
         "ref": "postgres-rds/src/jepsen/postgres_rds.clj",
         "db": None,
         "os": None,
+        # Real mode dials the managed endpoint from the control host
+        # via psql (the reference's conn-spec role) — pass
+        # rds_endpoint in opts.
+        "clients": {
+            "bank": lambda opts: PsqlBankClient(
+                endpoint=opts.get("rds_endpoint")
+            ),
+        },
         "workloads": {"bank": _bank_wl},
     },
     # mongodb-smartos: the SmartOS/ipfilter port of the mongo suite
@@ -292,6 +350,11 @@ SUITES: Dict[str, Dict[str, Any]] = {
         ),
         "os": SmartOS(),
         "net": netlib.IpfilterNet(),
+        # Real mode speaks the mongo wire protocol (OP_QUERY command
+        # path + BSON, protocols/mongo.py) for document-cas.
+        "clients": {
+            "document-cas": lambda opts: MongoRegisterClient(),
+        },
         "workloads": {
             "document-cas": _register_wl,
             "transfer": _bank_wl,

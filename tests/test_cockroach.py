@@ -281,21 +281,40 @@ def test_sql_bank_transfer_zero_row_is_fail():
     assert out.type == "ok"
 
 
-def test_galera_bank_transfer_zero_row_is_fail():
+def _galera_transfer(cli_output: str):
     from jepsen_tpu.suites import galera
 
-    remote = DummyRemote({"SELECT ROW_COUNT()": (0, "ROW_COUNT()\n0\n", "")})
+    remote = DummyRemote({"UPDATE accounts": (0, cli_output, "")})
     test = {"nodes": ["n1"], "remote": remote}
     c = galera.GaleraBankClient().open(test, "n1")
-    out = c.invoke(
+    return c.invoke(
         test, invoke_op(0, "transfer", {"from": 0, "to": 1, "amount": 5})
     )
-    assert out.type == "fail"
 
-    remote = DummyRemote({"SELECT ROW_COUNT()": (0, "ROW_COUNT()\n1\n", "")})
-    test = {"nodes": ["n1"], "remote": remote}
-    c = galera.GaleraBankClient().open(test, "n1")
-    out = c.invoke(
-        test, invoke_op(0, "transfer", {"from": 0, "to": 1, "amount": 5})
+
+def test_galera_bank_transfer_zero_row_is_fail():
+    # Real `mysql --batch` output shape: header line then the value.
+    hdr = "CONCAT('applied=', ROW_COUNT())"
+    assert _galera_transfer(f"{hdr}\napplied=0\n").type == "fail"
+    assert _galera_transfer(f"{hdr}\napplied=1\n").type == "ok"
+
+
+def test_galera_bank_transfer_survives_cli_decoration():
+    # Detection keys on the tagged row, not on "last line is a digit":
+    # a trailing warning/notice after the value must not flip an
+    # applied transfer to :fail (ADVICE r4).
+    out = _galera_transfer(
+        "CONCAT('applied=', ROW_COUNT())\napplied=1\n"
+        "Warning: Using a password on the command line can be "
+        "insecure.\n"
     )
     assert out.type == "ok"
+
+
+def test_galera_bank_transfer_missing_row_is_indeterminate():
+    # No tagged row: the batch may have partially applied — the client
+    # must raise (worker records :info), not claim a clean :fail.
+    import pytest
+
+    with pytest.raises(RuntimeError, match="transfer result row"):
+        _galera_transfer("mysql: some unrelated failure output\n")

@@ -1,0 +1,215 @@
+"""Memcache text-protocol client over a real socket (the hazelcast
+real-wire path, protocols/memcache.py) — same discipline as
+tests/test_resp.py: a threaded in-process server speaks the actual
+bytes, and the clients' completion semantics are asserted against it.
+"""
+
+import random
+import socket
+import socketserver
+import threading
+
+import pytest
+
+from jepsen_tpu.history.ops import invoke_op
+from jepsen_tpu.protocols.memcache import (
+    McProtocolError,
+    McServerError,
+    MemcacheConnection,
+    MemcacheCounterClient,
+    MemcacheRegisterClient,
+)
+from jepsen_tpu.runtime.client import ClientFailed
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        store = self.server.store
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            parts = line.strip().split()
+            if not parts:
+                continue
+            verb = parts[0]
+            if verb == b"get":
+                key = parts[1].decode()
+                if key in store:
+                    v = store[key]
+                    self.wfile.write(
+                        b"VALUE %s 0 %d\r\n%s\r\nEND\r\n"
+                        % (key.encode(), len(v), v)
+                    )
+                else:
+                    self.wfile.write(b"END\r\n")
+            elif verb in (b"set", b"add"):
+                key = parts[1].decode()
+                n = int(parts[4])
+                data = self.rfile.read(n + 2)[:n]
+                if verb == b"add" and key in store:
+                    self.wfile.write(b"NOT_STORED\r\n")
+                else:
+                    store[key] = data
+                    self.wfile.write(b"STORED\r\n")
+            elif verb == b"delete":
+                key = parts[1].decode()
+                if store.pop(key, None) is not None:
+                    self.wfile.write(b"DELETED\r\n")
+                else:
+                    self.wfile.write(b"NOT_FOUND\r\n")
+            elif verb in (b"incr", b"decr"):
+                key = parts[1].decode()
+                if key not in store:
+                    self.wfile.write(b"NOT_FOUND\r\n")
+                else:
+                    cur = int(store[key])
+                    d = int(parts[2])
+                    cur = cur + d if verb == b"incr" else max(cur - d, 0)
+                    store[key] = str(cur).encode()
+                    self.wfile.write(b"%d\r\n" % cur)
+            else:
+                self.wfile.write(b"ERROR\r\n")
+            self.wfile.flush()
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+@pytest.fixture()
+def server():
+    srv = _Server(("127.0.0.1", 0), _Handler)
+    srv.store = {}
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    srv.port = srv.server_address[1]
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_connection_verbs(server):
+    c = MemcacheConnection("127.0.0.1", server.port)
+    assert c.get("k") is None
+    assert c.set("k", b"5") is True
+    assert c.get("k") == b"5"
+    assert c.add("k", b"6") is False  # exists
+    assert c.incr("k", 3) == 8
+    assert c.decr("k", 2) == 6
+    assert c.delete("k") is True
+    assert c.delete("k") is False
+    assert c.incr("k", 1) is None  # NOT_FOUND
+    c.close()
+
+
+def test_register_client_real_socket(server):
+    test = {"nodes": ["127.0.0.1"]}
+    c = MemcacheRegisterClient(port=server.port).open(test, "127.0.0.1")
+    assert c.invoke(test, invoke_op(0, "read")).value is None
+    assert c.invoke(test, invoke_op(0, "write", 3)).type == "ok"
+    assert c.invoke(test, invoke_op(0, "read")).value == 3
+    c.close(test)
+
+
+def test_counter_client_real_socket(server):
+    test = {"nodes": ["127.0.0.1"]}
+    c = MemcacheCounterClient(port=server.port).open(test, "127.0.0.1")
+    c.setup(test)
+    assert c.invoke(test, invoke_op(0, "add", 2)).type == "ok"
+    assert c.invoke(test, invoke_op(0, "add", 3)).type == "ok"
+    assert c.invoke(test, invoke_op(0, "read")).value == 5
+    c.close(test)
+
+
+def test_register_rejects_cas(server):
+    # No cas verb on the endpoint: programming error, not :fail/:info.
+    test = {"nodes": ["127.0.0.1"]}
+    c = MemcacheRegisterClient(port=server.port).open(test, "127.0.0.1")
+    with pytest.raises(ValueError):
+        c.invoke(test, invoke_op(0, "cas", [1, 2]))
+    c.close(test)
+
+
+def test_transport_error_semantics(server):
+    """Dead server: reads complete :fail (ClientFailed), writes crash
+    to :info (raise), and the connection is dropped for reconnect."""
+    test = {"nodes": ["127.0.0.1"]}
+    c = MemcacheRegisterClient(port=server.port).open(test, "127.0.0.1")
+    c.invoke(test, invoke_op(0, "write", 1))
+    c._conn.sock.close()  # simulate a cut
+    c._conn.sock = socket.socket()  # unconnected: sends fail
+    with pytest.raises((ClientFailed, ConnectionError, OSError)):
+        c.invoke(test, invoke_op(0, "read"))
+    assert c._conn is None  # dropped for lazy reconnect
+    # reconnects and works again
+    assert c.invoke(test, invoke_op(0, "read")).value == 1
+    c.close(test)
+
+
+def test_desync_is_protocol_error(server):
+    c = MemcacheConnection("127.0.0.1", server.port)
+    c._buf = b"VALUE k 0 nonsense\r\n"
+    with pytest.raises(McProtocolError):
+        c.get("k")
+    c.close()
+
+
+def test_server_error_is_definite(server):
+    c = MemcacheConnection("127.0.0.1", server.port)
+    c._buf = b"CLIENT_ERROR bad command line format\r\n"
+    with pytest.raises(McServerError):
+        c.get("k")
+    c.close()
+
+
+def test_hazelcast_real_mode_wires_memcache_clients():
+    from jepsen_tpu.suites import hazelcast as hz
+
+    t = hz.hazelcast_test({
+        "workload": "map-register",
+        "nodes": ["n1"],
+        "rng": random.Random(0),
+    })
+    assert isinstance(t["client"], MemcacheRegisterClient)
+    t = hz.hazelcast_test({
+        "workload": "counter",
+        "nodes": ["n1"],
+        "rng": random.Random(0),
+    })
+    assert isinstance(t["client"], MemcacheCounterClient)
+
+
+def test_hazelcast_dummy_mode_workloads_run():
+    from jepsen_tpu.runtime import run
+    from jepsen_tpu.suites import hazelcast as hz
+
+    for wl in ("map-register", "counter"):
+        t = hz.hazelcast_test({
+            "dummy": True,
+            "workload": wl,
+            "ops": 120,
+            "nodes": ["n1", "n2", "n3"],
+            "rng": random.Random(2),
+        })
+        t["concurrency"] = 4
+        r = run(t)["results"]
+        assert r["valid?"] is True, (wl, r)
+
+
+def test_memcache_endpoint_enabled_on_daemon():
+    from jepsen_tpu.control import DummyRemote
+    from jepsen_tpu.suites.hazelcast import HazelcastDB
+
+    remote = DummyRemote()
+    test = {"nodes": ["n1", "n2"]}
+    HazelcastDB().setup(test, "n1", _session(remote, "n1"))
+    cmds = remote.commands("n1")
+    assert any("hazelcast.memcache.enabled=true" in c for c in cmds)
+
+
+def _session(remote, node):
+    from jepsen_tpu.control.core import Session
+
+    return Session(remote, node)

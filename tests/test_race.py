@@ -1,0 +1,107 @@
+"""Competition-race mechanics (knossos `competition` analog,
+jepsen/src/jepsen/checker.clj:128-144): the native C++ oracle races
+the TPU kernel, first definite verdict wins, and verdicts cross-check
+when both land. The TPU side is faked here (no accelerator on the test
+host); the native thread, winner selection, cross-check accounting and
+the eligibility gate are all real."""
+
+import random
+import time
+
+import pytest
+
+import jepsen_tpu.checker.linearizable as lin
+from jepsen_tpu.checker.events import history_to_events
+from jepsen_tpu.checker.models import model as get_model
+from jepsen_tpu.checker.wgl_native import available as native_available
+from jepsen_tpu.sim import gen_register_history
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain"
+)
+
+
+class FakeOut:
+    def __init__(self, ready_at):
+        self.ready_at = ready_at
+
+    def is_ready(self):
+        return time.perf_counter() >= self.ready_at
+
+
+def _stream(n_ops=200, seed=5):
+    h = gen_register_history(
+        random.Random(seed), n_ops=n_ops, n_procs=4, p_crash=0.01
+    )
+    return history_to_events(h)
+
+
+def _handle(ready_in):
+    return ([FakeOut(time.perf_counter() + ready_in)], None, None)
+
+
+def test_native_wins_when_tpu_slow():
+    lin.reset_race_stats()
+    ev = _stream()
+    racer = lin._NativeRacer(ev, "cas-register")
+    # TPU "ready" far in the future: the oracle must win.
+    out = lin._race_decide(ev, None, _handle(30.0), racer, "cas-register")
+    assert out is not None
+    assert out["valid?"] is True
+    assert out["method"] == "cpu-oracle-native"
+    assert out["race_winner"] == "native"
+    assert lin.RACE_STATS["native_wins"] == 1
+
+
+def test_tpu_wins_when_ready_first():
+    lin.reset_race_stats()
+    ev = _stream()
+    racer = lin._NativeRacer(ev, "cas-register")
+    out = lin._race_decide(ev, None, _handle(0.0), racer, "cas-register")
+    assert out is None  # caller collects the TPU verdict
+    lin._race_crosscheck(racer, True)
+    assert lin.RACE_STATS["tpu_wins"] == 1
+    # the oracle on a 200-op stream lands within the grace window
+    assert lin.RACE_STATS["crosschecked"] == 1
+    assert lin.RACE_STATS["mismatches"] == 0
+
+
+def test_crosscheck_counts_mismatch():
+    lin.reset_race_stats()
+    ev = _stream()
+    racer = lin._NativeRacer(ev, "cas-register")
+    racer.join(10.0)
+    # Claim the TPU said invalid while the oracle says valid: the
+    # mismatch must be counted (and logged), not raised.
+    lin._race_crosscheck(racer, False)
+    assert lin.RACE_STATS["mismatches"] == 1
+
+
+def test_native_win_invalid_carries_failure_report():
+    lin.reset_race_stats()
+    # Non-linearizable literal history: read sees a never-written value.
+    from jepsen_tpu.history.history import History
+    from jepsen_tpu.history.ops import invoke_op, ok_op
+
+    h = History([
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(1, "read"),
+        ok_op(1, "read", 2),
+    ])
+    ev = history_to_events(h)
+    racer = lin._NativeRacer(ev, "cas-register")
+    out = lin._race_decide(ev, None, _handle(30.0), racer, "cas-register")
+    assert out is not None
+    assert out["valid?"] is False
+    assert out["failed_op_index"] is not None
+    assert "failure" in out and out["failure"]["configs"]
+
+
+def test_eligibility_gate():
+    ev = _stream(n_ops=100)
+    m = get_model("cas-register")
+    assert lin._race_eligible(ev, m)
+    big = _stream(n_ops=100)
+    big.n_ops = lin.RACE_MAX_OPS + 1  # size gate
+    assert not lin._race_eligible(big, m)

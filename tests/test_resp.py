@@ -295,6 +295,22 @@ def test_drain_with_consumed_jobs_goes_info_not_fail(server):
     c.close(test)
 
 
+def test_malformed_number_field_is_transport_error(server):
+    """A malformed integer/length field (':abc', '$xyz', '*xyz') is a
+    desynced stream, not a programming error: it must raise
+    RespProtocolError (transport family -> :info + stream drop), not a
+    bare ValueError that clients.py's unknown-op re-raise would pass
+    through without resetting the connection (ADVICE r4)."""
+    from jepsen_tpu.protocols.resp import RespProtocolError
+
+    for frame in (b":abc\r\n", b"$xyz\r\n", b"*xyz\r\n"):
+        c = RespConnection("127.0.0.1", server.port)
+        c._buf = frame
+        with pytest.raises(RespProtocolError):
+            c.call("GET", "k")
+        c.close()
+
+
 def test_protocol_desync_is_transport_error(server):
     """An unintelligible frame must surface as a ConnectionError
     (transport family -> :info + stream drop), never as a definite

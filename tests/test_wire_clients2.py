@@ -1,0 +1,348 @@
+"""Wire clients round 2: ignite binary thin-client, mongo
+OP_QUERY/BSON, robustirc HTTP/JSON — each against an in-process fake
+server speaking the real bytes (the tests/test_resp.py discipline)."""
+
+import json
+import socketserver
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from jepsen_tpu.history.ops import invoke_op
+from jepsen_tpu.runtime.client import ClientFailed
+
+# -- ignite ------------------------------------------------------------------
+
+
+class _IgniteHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        from jepsen_tpu.protocols import ignite as ig
+
+        # handshake
+        (n,) = struct.unpack("<i", self.rfile.read(4))
+        self.rfile.read(n)
+        self.wfile.write(struct.pack("<i", 1) + b"\x01")
+        self.wfile.flush()
+        store = self.server.store
+        while True:
+            hdr = self.rfile.read(4)
+            if len(hdr) < 4:
+                return
+            (n,) = struct.unpack("<i", hdr)
+            body = self.rfile.read(n)
+            op, rid = struct.unpack_from("<hq", body, 0)
+            payload = body[10:]
+            out = b""
+            if op == ig.OP_CACHE_GET_OR_CREATE_WITH_NAME:
+                pass
+            elif op == ig.OP_CACHE_GET:
+                key, _ = ig.dec(payload, 5)
+                out = ig.enc(store.get(key))
+            elif op == ig.OP_CACHE_PUT:
+                key, off = ig.dec(payload, 5)
+                val, _ = ig.dec(payload, off)
+                store[key] = val
+            elif op == ig.OP_CACHE_REPLACE_IF_EQUALS:
+                key, off = ig.dec(payload, 5)
+                exp, off = ig.dec(payload, off)
+                new, _ = ig.dec(payload, off)
+                ok = store.get(key) == exp
+                if ok:
+                    store[key] = new
+                out = ig.enc(ok)
+            resp = struct.pack("<qi", rid, 0) + out
+            self.wfile.write(struct.pack("<i", len(resp)) + resp)
+            self.wfile.flush()
+
+
+class _TcpServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+@pytest.fixture()
+def ignite_server():
+    srv = _TcpServer(("127.0.0.1", 0), _IgniteHandler)
+    srv.store = {}
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    srv.port = srv.server_address[1]
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_ignite_register_over_wire(ignite_server):
+    from jepsen_tpu.protocols.ignite import IgniteRegisterClient
+
+    test = {"nodes": ["127.0.0.1"]}
+    c = IgniteRegisterClient(port=ignite_server.port).open(
+        test, "127.0.0.1"
+    )
+    c.setup(test)
+    assert c.invoke(test, invoke_op(0, "read")).value is None
+    assert c.invoke(test, invoke_op(0, "write", 3)).type == "ok"
+    assert c.invoke(test, invoke_op(0, "cas", [3, 5])).type == "ok"
+    assert c.invoke(test, invoke_op(0, "cas", [3, 9])).type == "fail"
+    assert c.invoke(test, invoke_op(0, "read")).value == 5
+    c.close(test)
+
+
+def test_ignite_java_string_hash():
+    from jepsen_tpu.protocols.ignite import java_string_hash
+
+    # Java semantics, incl. 32-bit wrap: "polygenelubricants" is the
+    # famous Integer.MIN_VALUE hash.
+    assert java_string_hash("") == 0
+    assert java_string_hash("a") == 97
+    assert java_string_hash("polygenelubricants") == -2147483648
+
+
+# -- mongo -------------------------------------------------------------------
+
+
+def test_bson_roundtrip():
+    from jepsen_tpu.protocols.mongo import bson_decode, bson_encode
+
+    doc = {
+        "find": "cas",
+        "filter": {"_id": 0, "value": None},
+        "limit": 1,
+        "big": 2**40,
+        "pi": 3.5,
+        "ok": True,
+        "arr": [1, "two", {"three": 3}],
+    }
+    out, _ = bson_decode(bson_encode(doc))
+    assert out == doc
+
+
+class _MongoHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        from jepsen_tpu.protocols import mongo as mg
+
+        store = self.server.store
+        while True:
+            hdr = self.rfile.read(16)
+            if len(hdr) < 16:
+                return
+            msglen, rid, _, opcode = struct.unpack("<iiii", hdr)
+            body = self.rfile.read(msglen - 16)
+            # flags(4) + cstring + skip(4) + nret(4) + bson
+            off = 4
+            nul = body.index(b"\0", off)
+            off = nul + 1 + 8
+            cmd, _ = mg.bson_decode(body, off)
+            if "find" in cmd:
+                doc = store.get(cmd["filter"]["_id"])
+                batch = [doc] if doc else []
+                reply = {"cursor": {"firstBatch": batch, "id": 0},
+                         "ok": 1}
+            elif "update" in cmd:
+                u = cmd["updates"][0]
+                q, upd = u["q"], u["u"]["$set"]
+                doc = store.get(q["_id"])
+                matches = doc is not None and all(
+                    doc.get(k) == v for k, v in q.items() if k != "_id"
+                )
+                if matches:
+                    doc.update(upd)
+                    reply = {"n": 1, "nModified": 1, "ok": 1}
+                elif u.get("upsert") and "value" not in q:
+                    store[q["_id"]] = {"_id": q["_id"], **upd}
+                    reply = {"n": 1, "nModified": 0, "ok": 1}
+                else:
+                    reply = {"n": 0, "nModified": 0, "ok": 1}
+            else:
+                reply = {"ok": 0, "errmsg": f"unknown {list(cmd)[0]}"}
+            doc_bytes = mg.bson_encode(reply)
+            resp_body = (
+                struct.pack("<i", 0) + struct.pack("<q", 0)
+                + struct.pack("<ii", 0, 1) + doc_bytes
+            )
+            out = struct.pack(
+                "<iiii", 16 + len(resp_body), 1, rid, mg.OP_REPLY
+            ) + resp_body
+            self.wfile.write(out)
+            self.wfile.flush()
+
+
+@pytest.fixture()
+def mongo_server():
+    srv = _TcpServer(("127.0.0.1", 0), _MongoHandler)
+    srv.store = {}
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    srv.port = srv.server_address[1]
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_mongo_document_cas_over_wire(mongo_server):
+    from jepsen_tpu.protocols.mongo import MongoRegisterClient
+
+    test = {"nodes": ["127.0.0.1"]}
+    c = MongoRegisterClient(port=mongo_server.port).open(
+        test, "127.0.0.1"
+    )
+    assert c.invoke(test, invoke_op(0, "read")).value is None
+    assert c.invoke(test, invoke_op(0, "write", 2)).type == "ok"
+    assert c.invoke(test, invoke_op(0, "read")).value == 2
+    assert c.invoke(test, invoke_op(0, "cas", [2, 7])).type == "ok"
+    assert c.invoke(test, invoke_op(0, "cas", [2, 9])).type == "fail"
+    assert c.invoke(test, invoke_op(0, "read")).value == 7
+    c.close(test)
+
+
+def test_mongo_write_concern_error_is_indeterminate(mongo_server):
+    """ok:1 with writeConcernError means applied-but-maybe-not-durable:
+    must crash to :info (raise), never record :ok (the write can roll
+    back on failover and fabricate a false linearizability verdict)."""
+    from jepsen_tpu.protocols.mongo import MongoRegisterClient
+
+    test = {"nodes": ["127.0.0.1"]}
+    c = MongoRegisterClient(port=mongo_server.port).open(
+        test, "127.0.0.1"
+    )
+    real = c.conn().command
+
+    def patched(db, cmd):
+        res = real(db, cmd)
+        if "update" in cmd:
+            res["writeConcernError"] = {
+                "code": 64, "errmsg": "waiting for replication timed out"
+            }
+        return res
+
+    c._conn.command = patched
+    with pytest.raises(RuntimeError, match="write concern"):
+        c.invoke(test, invoke_op(0, "write", 1))
+    c.close(test)
+
+
+def test_mongo_write_errors_are_definite_fail(mongo_server):
+    from jepsen_tpu.protocols.mongo import MongoRegisterClient
+
+    test = {"nodes": ["127.0.0.1"]}
+    c = MongoRegisterClient(port=mongo_server.port).open(
+        test, "127.0.0.1"
+    )
+    real = c.conn().command
+
+    def patched(db, cmd):
+        res = real(db, cmd)
+        if "update" in cmd:
+            res["writeErrors"] = [{"index": 0, "code": 11000,
+                                   "errmsg": "duplicate key"}]
+        return res
+
+    c._conn.command = patched
+    out_err = None
+    try:
+        c.invoke(test, invoke_op(0, "write", 1))
+    except ClientFailed as e:
+        out_err = e
+    assert out_err is not None  # definite rejection -> :fail family
+    c.close(test)
+
+
+# -- robustirc ---------------------------------------------------------------
+
+
+class _RobustHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _json(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n) or b"{}")
+        if self.path == "/robustirc/v1/session":
+            self._json(200, {"Sessionid": "s1", "Sessionauth": "a1"})
+        elif self.path == "/robustirc/v1/s1/message":
+            self.server.messages.append(body["Data"])
+            self._json(200, {})
+        else:
+            self._json(404, {"error": "nope"})
+
+    def do_GET(self):
+        if "/messages" in self.path:
+            body = b"".join(
+                json.dumps({"Data": d}).encode() + b"\n"
+                for d in self.server.messages
+            )
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._json(404, {})
+
+
+@pytest.fixture()
+def robust_server():
+    srv = HTTPServer(("127.0.0.1", 0), _RobustHandler)
+    srv.messages = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    srv.port = srv.server_address[1]
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_robustirc_log_over_http(robust_server):
+    from jepsen_tpu.protocols.robustirc import RobustIrcLogClient
+
+    test = {"nodes": ["127.0.0.1"]}
+    c = RobustIrcLogClient(
+        port=robust_server.port, tls=False
+    ).open(test, "127.0.0.1")
+    assert c.invoke(test, invoke_op(0, "add", 1)).type == "ok"
+    assert c.invoke(test, invoke_op(0, "add", 2)).type == "ok"
+    out = c.invoke(test, invoke_op(0, "read"))
+    assert out.type == "ok" and out.value == [1, 2]
+    c.close(test)
+    # session bootstrap spoke IRC: NICK/USER/JOIN went through
+    assert any(m.startswith("NICK ") for m in robust_server.messages)
+    assert any(m.startswith("JOIN ") for m in robust_server.messages)
+
+
+def test_robustirc_4xx_is_definite_fail(robust_server):
+    from jepsen_tpu.protocols.robustirc import RobustIrcLogClient
+
+    test = {"nodes": ["127.0.0.1"]}
+    c = RobustIrcLogClient(
+        port=robust_server.port, tls=False
+    ).open(test, "127.0.0.1")
+    # pre-open a session, then invalidate it -> 404 from the fake
+    s = c.session()
+    s.sid = "expired"
+    with pytest.raises(ClientFailed):
+        c.invoke(test, invoke_op(0, "add", 3))
+    c.close(test)
+
+
+def test_registry_real_mode_wires_round2_clients():
+    from jepsen_tpu.protocols.ignite import IgniteRegisterClient
+    from jepsen_tpu.protocols.mongo import MongoRegisterClient
+    from jepsen_tpu.protocols.robustirc import RobustIrcLogClient
+    from jepsen_tpu.suites.simple import make_test
+
+    cases = {
+        "ignite": ("register", IgniteRegisterClient),
+        "robustirc": ("set", RobustIrcLogClient),
+        "mongodb-smartos": ("document-cas", MongoRegisterClient),
+    }
+    for suite, (wl, cls) in cases.items():
+        t = make_test(suite, {"workload": wl, "nodes": ["n1"]})
+        assert isinstance(t["client"], cls), (suite, t["client"])
