@@ -561,6 +561,145 @@ def check_events_bucketed(
     )
 
 
+def split_queue_history_by_value(history):
+    """Per-value subhistories of an unordered-queue history, or None
+    when the history doesn't decompose (non-enq/deq ops, or a
+    pathological ok-dequeue/enqueue of nil).
+
+    Soundness: the unordered queue's state factorizes by value —
+    enqueue is always enabled, dequeue(v) is gated only by v's own
+    count, and transitions of distinct values commute — so this is
+    Herlihy-Wing locality with each value as its own object: H is
+    linearizable iff every per-value subhistory is. (Pick
+    linearization points per subhistory witness; the ops are disjoint,
+    so the pointwise merge is a global witness.) Crashed dequeues with
+    unknown value can never linearize (the model's NIL rule — the
+    value taken can't be named), so they are vacuous and dropped, same
+    as the joint model treats them.
+
+    The payoff is the device envelope: each subhistory has ONE value
+    (interning to code 0) and a tiny window, so any queue history
+    whose per-value enqueue count fits a nibble rides the packed
+    kernels — the value-domain bound disappears entirely
+    (models.PACKED_QUEUE_MAX_CODES no longer limits whole histories).
+    """
+    import itertools
+    from collections import defaultdict
+
+    from jepsen_tpu.checker.models import F_DEQ, F_ENQ, QUEUE_F_NAMES
+    from jepsen_tpu.history.history import History
+
+    subs = defaultdict(list)
+    synth = itertools.count(len(history))
+    for op in history:
+        if not op.is_invoke:
+            continue
+        comp = history.completion(op)
+        if op.f == "drain":
+            # Drain = a batch of dequeues in one interval. Expansion
+            # into per-value dequeue pairs is EXACT for the unordered
+            # queue (the total-queue expansion discipline,
+            # checker.clj:570-629): removals only shrink enabledness,
+            # so any witness using a mid-drain state has an equivalent
+            # one using the pre-drain state — atomicity of the batch
+            # constrains nothing observable. A crashed drain's values
+            # are unknown and removal-only: vacuous, dropped.
+            if comp is not None and comp.type == "ok":
+                for v in comp.value or ():
+                    if v is None:
+                        return None
+                    # Unique synthetic indices: a drain of [a, a]
+                    # contributes two pairs to subs[a], and duplicate
+                    # indices would corrupt the substream's pairing.
+                    # (They no longer name a real history op; failure
+                    # reports cite the drain via failed_value.)
+                    subs[v].append(op.with_(
+                        f="dequeue", value=None, index=next(synth)
+                    ))
+                    subs[v].append(comp.with_(
+                        f="dequeue", value=v, index=next(synth)
+                    ))
+            continue
+        fcode = QUEUE_F_NAMES.get(op.f)
+        if fcode is None:
+            return None  # not a pure enqueue/dequeue history
+        if fcode == F_ENQ:
+            v = op.value
+        else:
+            v = (
+                comp.value
+                if comp is not None and comp.type == "ok"
+                else None
+            )
+        if v is None:
+            if fcode == F_DEQ:
+                continue  # NIL dequeue: vacuous (docstring)
+            return None  # enqueue of nil: keep the joint tuple path
+        subs[v].append(op)
+        if comp is not None:
+            subs[v].append(comp)
+    return {
+        v: History(ops, indexed=True) for v, ops in subs.items()
+    }
+
+
+def check_queue_by_value(history, model: str, init_value=None):
+    """Batched per-value queue check (split_queue_history_by_value),
+    or None when the history doesn't decompose / a subhistory blows
+    the window. Verdict merge: valid iff every value is; the first
+    invalid value re-checks through the joint single-stream machinery
+    for its failure report."""
+    subs = split_queue_history_by_value(history)
+    if subs is None or not subs:
+        return None
+    try:
+        streams = {
+            v: history_to_events(sub, model=model, init_value=init_value)
+            for v, sub in subs.items()
+        }
+    except WindowOverflow:
+        return None
+    from jepsen_tpu.checker.sharded import check_keys
+
+    results = check_keys(list(streams.values()), model=model)
+    methods: dict = {}
+    for r in results:
+        methods[r["method"]] = methods.get(r["method"], 0) + 1
+    out = {
+        "valid?": True,
+        "method": "per-value:" + ",".join(
+            f"{m}x{n}" for m, n in sorted(methods.items())
+        ),
+        "n_values": len(subs),
+        "frontier_k": None,
+        "escalations": sum(r.get("escalations", 0) for r in results),
+    }
+    for v, r in zip(streams, results):
+        if r["valid?"] is False:
+            detail = check_events_bucketed(streams[v], model=model)
+            out["valid?"] = False
+            out["failed_value"] = v
+            out["failed_op_index"] = detail.get("failed_op_index")
+            if "failure" in detail:
+                out["failure"] = detail["failure"]
+            else:
+                # index-only engine decided (K-frontier rung): harvest
+                # the report from the Python oracle on the one failing
+                # substream (same policy as the checker tail).
+                from jepsen_tpu.checker.wgl_oracle import check_events
+
+                _, py_stats = check_events(
+                    streams[v], model=model, return_stats=True
+                )
+                failure = oracle_failure_report(
+                    streams[v], py_stats, model
+                )
+                if failure is not None:
+                    out["failure"] = failure
+            break
+    return out
+
+
 class LinearizableChecker:
     """Checker-protocol adapter for the WGL engine.
 
@@ -586,6 +725,19 @@ class LinearizableChecker:
         if not isinstance(history, History):
             history = History(history)
         t0 = time.perf_counter()
+        if self.model == "unordered-queue" and self.use_tpu:
+            # Queue histories decompose by value (locality — see
+            # split_queue_history_by_value): one batched kernel pass
+            # over per-value substreams instead of a joint scan whose
+            # packed envelope real value domains immediately exceed.
+            out = check_queue_by_value(
+                history, self.model, init_value=self.init_value
+            )
+            if out is not None:
+                out["n_ops"] = len(history)
+                out["wall_s"] = time.perf_counter() - t0
+                self._render_failure(test, out, opts)
+                return out
         try:
             events = history_to_events(
                 history, model=self.model, init_value=self.init_value
@@ -627,9 +779,14 @@ class LinearizableChecker:
             if failure is not None:
                 out["failure"] = failure
         out["wall_s"] = time.perf_counter() - t0
-        # Render the death report (the reference's linear.svg,
-        # checker.clj:146-154) next to results.json when a run dir is
-        # in play; per-key checks land in their key subdirectory.
+        self._render_failure(test, out, opts)
+        return out
+
+    @staticmethod
+    def _render_failure(test, out, opts) -> None:
+        """Render the death report (the reference's linear.svg,
+        checker.clj:146-154) next to results.json when a run dir is
+        in play; per-key checks land in their key subdirectory."""
         run_dir = (opts or {}).get("subdirectory") or (
             test.get("run_dir") if isinstance(test, dict) else None
         )
@@ -643,7 +800,6 @@ class LinearizableChecker:
                 )
             except OSError:
                 pass
-        return out
 
 
 def linearizable(model: str = "cas-register", **kw) -> LinearizableChecker:

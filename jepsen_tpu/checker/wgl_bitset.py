@@ -96,21 +96,29 @@ def step_block(W: int, interpret: bool = False) -> int:
     TPU handles the full block."""
     if interpret:
         return 4
-    return STEP_BLOCK if W <= 16 else 1
+    # Wide windows keep a smaller block (compile time grows with the
+    # unrolled body x lane count), but at least 8: a 1-step block's
+    # meta BlockSpec (1, 1, META_COLS) violates the TPU lowering's
+    # sublane-divisibility rule.
+    return STEP_BLOCK if W <= 16 else 8
 
 #: mask-word lane floor: smaller windows still use full vector lanes
 MIN_WORDS = 128
 
-#: supported window buckets (2^W/32 words: 128..2048 lanes). Per-step
+#: supported window buckets (2^W/32 words: 128..16384 lanes). Per-step
 #: vector cost scales with 2^W once the per-step machinery is paid, so
-#: every width 12..16 is its own bucket and the segment planner moves
-#:  between them as the live window fluctuates (measured on v5e: the
+#: every width is its own bucket and the segment planner moves between
+#: them as the live window fluctuates (measured on v5e: the
 #: leading-prefix-only W12/W16 split left 25k+ of the north star's
-#: steps running 16x too wide). W=20 was attempted and abandoned:
-#: Mosaic does not finish compiling the closure kernel over 32768-lane
-#: tensors in any reasonable time (>10 min even with a 1-substep
-#: grid), so windows past 16 route to the K-frontier ladder instead.
-W_BUCKETS = (12, 13, 14, 15, 16)
+#: steps running 16x too wide). W=17-19 compile in 27-95 s on the fast
+#: tier (cached thereafter) and keep crash-heavy tails EXACT on device
+#: at ~37-120 us/step — still ahead of the native C++ oracle's ~90
+#: us/step, and far ahead of the K-frontier ladder's
+#: escalate-then-oracle path these windows previously took. W=20 was
+#: attempted and abandoned: Mosaic does not finish compiling the
+#: closure kernel over 32768-lane tensors in any reasonable time
+#: (>10 min), so windows past 19 route to the K-frontier ladder.
+W_BUCKETS = (12, 13, 14, 15, 16, 17, 18, 19)
 
 #: state-row cap (VMEM: 32 x 2048 x 4 B = 256 KB at W=16)
 MAX_ROWS = 32

@@ -225,6 +225,8 @@ def _queue_workload(opts):
     def enq():
         return {"f": "enqueue", "value": next(counter)}
 
+    from jepsen_tpu.checker.core import compose
+
     return {
         "client": QueueClient(weak=weak, rng=rng),
         "generator": gen.clients(gen.limit(
@@ -235,7 +237,15 @@ def _queue_workload(opts):
         "final_generator": gen.clients(
             gen.each_thread(gen.once({"f": "drain"}))
         ),
-        "checker": reductions.total_queue(),
+        # conservation (checker.clj:570-629) AND full queue
+        # linearizability — the latter decomposes by value onto the
+        # device kernels (linearizable.split_queue_history_by_value)
+        "checker": compose({
+            "total-queue": reductions.total_queue(),
+            "linearizable": LinearizableChecker(
+                model="unordered-queue"
+            ),
+        }),
     }
 
 

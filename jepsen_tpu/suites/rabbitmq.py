@@ -20,7 +20,8 @@ import random
 from typing import Any, Callable, Dict, Optional
 
 from jepsen_tpu import net as netlib, nemesis as nemlib
-from jepsen_tpu.checker import reductions
+from jepsen_tpu.checker import core as checker_core, reductions
+from jepsen_tpu.checker.linearizable import LinearizableChecker
 from jepsen_tpu.control.core import sessions_for
 from jepsen_tpu.db import DB
 from jepsen_tpu.generator import pure as gen
@@ -158,7 +159,12 @@ def rabbitmq_test(opts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         "final_generator": gen.clients(
             gen.each_thread(gen.once({"f": "drain"}))
         ),
-        "checker": reductions.total_queue(),
+        "checker": checker_core.compose({
+            "total-queue": reductions.total_queue(),
+            "linearizable": LinearizableChecker(
+                model="unordered-queue"
+            ),
+        }),
     }
     if dummy:
         from jepsen_tpu.suites.hazelcast import QueueClient

@@ -290,3 +290,55 @@ def test_segmented_scan_parity():
         if not alive:
             assert died == one_died
     assert segmented_hit >= 2  # the two-launch path actually ran
+
+
+def test_wide_bucket_w17_interpret():
+    """W=17-19 are real buckets now (wgl_bitset.W_BUCKETS): a small
+    W17 stream must produce exact verdicts through the two-tier scan
+    in interpret mode, both alive and dead. (The crash-heavy sweeps
+    on real hardware live in the round notes; this pins the plumbing:
+    block specs, lane rolls and fast->exact escalation at 4096
+    lanes.)"""
+    import dataclasses
+
+    import numpy as np
+
+    from jepsen_tpu.checker.events import events_to_steps, history_to_events
+    from jepsen_tpu.checker.wgl_oracle import check_events
+
+    h = History([
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(1, "read"),
+        ok_op(1, "read", 1),
+        invoke_op(2, "cas", [1, 2]),
+        ok_op(2, "cas", [1, 2]),
+        invoke_op(3, "read"),
+        ok_op(3, "read", 2),
+    ])
+    ev = history_to_events(h)
+    steps = events_to_steps(ev, W=16)
+    pad = 17 - steps.occ.shape[1]
+    f = lambda a: np.pad(a, ((0, 0), (0, pad)))  # noqa: E731
+    wide = dataclasses.replace(
+        steps, occ=f(steps.occ), f=f(steps.f), a=f(steps.a),
+        b=f(steps.b), W=17, fresh=steps.fresh,
+    )
+    alive, taint, died = check_steps_bitset(wide, interpret=True)
+    assert alive is True and not taint
+
+    bad = History(list(h) + [
+        invoke_op(4, "read"),
+        ok_op(4, "read", 1),  # stale: register now holds 2
+    ])
+    evb = history_to_events(bad)
+    sb = events_to_steps(evb, W=16)
+    wb = dataclasses.replace(
+        sb, occ=f(sb.occ)[: len(sb)], f=f(sb.f)[: len(sb)],
+        a=f(sb.a)[: len(sb)], b=f(sb.b)[: len(sb)], W=17,
+        fresh=sb.fresh,
+    )
+    alive, taint, died = check_steps_bitset(wb, interpret=True)
+    want = check_events(evb, model="cas-register")
+    assert alive is want is False
+    assert died == 9
