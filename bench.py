@@ -275,10 +275,23 @@ def bench_register_plane():
     # Pipelined: one dispatch train, one sync, whole register plane.
     # Best-effort: a failure here must never kill the bench (the solo
     # measurements above are the record).
+    pipe_walls = None
     try:
-        pipe_wall, pipe_ok = _time(
-            lambda: _register_plane_pipelined(etcd, zk, ns), reps=3
+        # Smoke on a non-TPU backend still exercises the train (and
+        # publishes pipelined walls) via Pallas interpret mode; the
+        # walls are then schema-valid but not performance numbers.
+        from jepsen_tpu.checker.linearizable import _on_tpu
+
+        interp = SMOKE and not _on_tpu()
+        pipe_wall, pipe_out = _time(
+            lambda: _register_plane_pipelined(
+                etcd, zk, ns, interpret=interp
+            ),
+            reps=1 if interp else 3,
         )
+        pipe_ok = pipe_out if pipe_out is None else pipe_out[0]
+        if pipe_ok:
+            pipe_walls = pipe_out[1]
         if pipe_ok is False:
             print(
                 "WARNING: pipelined register-plane verdicts diverged; "
@@ -292,12 +305,21 @@ def bench_register_plane():
         )
         pipe_wall, pipe_ok = float("nan"), None
 
+    # Race-enabled verdict-parity pass, OUTSIDE every timed region
+    # (the racer thread contends for the single host core): each etcd
+    # stream re-checks with the competition race forced on, verdicts
+    # gate against the oracle, and the cumulative RACE_STATS publish
+    # in engine_stats — the knossos competition role run in anger, not
+    # just unit-tested.
+    race = bench_race_parity(etcd, b_etcd["verdicts"])
+
     n_etcd = sum(s.n_ops for s in etcd)
     n_zk = sum(s.n_ops for s in zk)
     configs = [
         {
             "name": "etcd-1k",
             "n_ops": n_etcd,
+            "n_keys": len(etcd),
             "tpu_wall": etcd_wall,
             "oracle_wall": b_etcd["best_wall"],
             "python_wall": b_etcd["python_wall"],
@@ -310,6 +332,7 @@ def bench_register_plane():
         {
             "name": "zookeeper-10kx16",
             "n_ops": n_zk,
+            "n_keys": len(zk),
             "tpu_wall": zk_wall,
             "oracle_wall": b_zk["best_wall"],
             "python_wall": b_zk["python_wall"],
@@ -322,6 +345,7 @@ def bench_register_plane():
         {
             "name": "northstar-100k",
             "n_ops": ns.n_ops,
+            "n_keys": 1,
             "tpu_wall": ns_wall,
             "oracle_wall": b_ns["best_wall"],
             "python_wall": b_ns["python_wall"],
@@ -336,17 +360,23 @@ def bench_register_plane():
         "wall": pipe_wall,
         "n_ops": n_etcd + n_zk + ns.n_ops,
         "available": pipe_ok is not None,
+        "config_walls": pipe_walls,
+        "race": race,
     }
     return configs, pipeline
 
 
 def _register_plane_pipelined(etcd, zk, ns, interpret=False):
-    """Dispatch configs 1+2 as ONE batched kernel launch and the north
-    star's segment chain right behind it, then sync everything with a
-    single collect train. Returns True when all verdicts hold, None
-    when the bitset plan doesn't cover the inputs (non-TPU backend).
-    interpret=True runs the kernels in Pallas interpret mode so tests
-    exercise this exact path on CPU."""
+    """Dispatch each register config's kernel work back-to-back — the
+    etcd key batch, the zookeeper key batch, and the north star's
+    segment chain — then sync with one collect train. Returns
+    (ok, walls): ok True when all verdicts hold, and walls a per-config
+    dict of CUMULATIVE time from dispatch start to that config's
+    collect (the pipelined wall each config observes when riding the
+    shared train — the number the bench JSON publishes per config).
+    Returns None when the bitset plan doesn't cover the inputs
+    (non-TPU backend). interpret=True runs the kernels in Pallas
+    interpret mode so tests exercise this exact path on CPU."""
     from jepsen_tpu.checker import wgl_bitset as bs
     from jepsen_tpu.checker.events import clear_memos, events_to_steps
     from jepsen_tpu.checker.linearizable import _on_tpu
@@ -355,29 +385,149 @@ def _register_plane_pipelined(etcd, zk, ns, interpret=False):
     if not (_on_tpu() or interpret):
         return None
     m = get_model("cas-register")
-    batch = list(etcd) + list(zk)
-    window = max(s.window for s in batch)
-    plan = bs.plan(m, window, max(len(s.value_codes) for s in batch))
+    window = max(s.window for s in etcd + zk)
+    plan = bs.plan(
+        m, window, max(len(s.value_codes) for s in etcd + zk)
+    )
     ns_plan = bs.plan(m, ns.window, len(ns.value_codes))
     if plan is None or ns_plan is None:
         return None
-    for s in batch + [ns]:
+    for s in etcd + zk + [ns]:
         clear_memos(s)
     bW, S = plan
-    steps = [events_to_steps(s, W=bW) for s in batch]
+    t0 = time.perf_counter()
+    etcd_steps = [events_to_steps(s, W=bW) for s in etcd]
+    zk_steps = [events_to_steps(s, W=bW) for s in zk]
     nsW, nsS = ns_plan
     ns_steps = events_to_steps(ns, W=nsW)
-    h_batch = bs.launch_keys_bitset(
-        steps, model="cas-register", S=S, interpret=interpret
+    h_etcd = bs.launch_keys_bitset(
+        etcd_steps, model="cas-register", S=S, interpret=interpret
+    )
+    h_zk = bs.launch_keys_bitset(
+        zk_steps, model="cas-register", S=S, interpret=interpret
     )
     h_ns = bs.launch_steps_bitset_segmented(
         ns_steps, model="cas-register", S=nsS, interpret=interpret
     )
-    batch_verdicts = bs.collect_keys_bitset(h_batch)
+    walls = {}
+    etcd_verdicts = bs.collect_keys_bitset(h_etcd)
+    walls["etcd-1k"] = time.perf_counter() - t0
+    zk_verdicts = bs.collect_keys_bitset(h_zk)
+    walls["zookeeper-10kx16"] = time.perf_counter() - t0
     ns_verdict = bs.collect_steps_bitset_segmented(ns_steps, h_ns)
-    ok = all(v[0] and not v[1] for v in batch_verdicts)
+    walls["northstar-100k"] = time.perf_counter() - t0
+    ok = all(
+        v[0] and not v[1] for v in etcd_verdicts + zk_verdicts
+    )
     ok = ok and ns_verdict[0] and not ns_verdict[1]
-    return ok
+    return ok, walls
+
+
+def bench_race_parity(streams, expected):
+    """Re-check each stream with the competition race forced ON and
+    gate the verdicts against the oracle's. Returns the cumulative
+    RACE_STATS plus a parity flag, or None when the native oracle
+    isn't available (no toolchain: the race can't run). Never timed —
+    the racer thread contends with the check on a 1-core host."""
+    from jepsen_tpu.checker.events import clear_memos
+    from jepsen_tpu.checker.linearizable import (
+        RACE_STATS,
+        check_events_bucketed,
+        reset_race_stats,
+    )
+    from jepsen_tpu.checker.wgl_native import available
+
+    if not available():
+        return None
+    reset_race_stats()
+    parity = True
+    for s, want in zip(streams, expected):
+        clear_memos(s)
+        r = check_events_bucketed(s, race=True)
+        parity = parity and (r["valid?"] is want)
+    out = {"parity_ok": parity, "n_streams": len(streams)}
+    out.update(RACE_STATS)
+    if not parity or RACE_STATS["mismatches"]:
+        print(
+            f"WARNING: race parity pass found disagreement: {out}",
+            file=sys.stderr,
+        )
+    return out
+
+
+def bench_host_prep():
+    """Host-prep microbench on the north-star-shaped stream (100k ops
+    regardless of --smoke — the acceptance number is for this size):
+    events_to_steps + segment plan + per-segment packing, old
+    vectorized path (_events_to_steps_v1) vs the current dispatcher
+    (native C++ prep when the toolchain is present, fused numpy
+    otherwise). Byte-identity between the two paths is asserted before
+    timing counts (same discipline as the verdict gates)."""
+    from jepsen_tpu.checker import wgl_bitset as bs
+    from jepsen_tpu.checker.events import (
+        _events_to_steps_v1,
+        bucket,
+        clear_memos,
+        events_to_steps,
+        history_to_events,
+    )
+    from jepsen_tpu.checker.models import model as get_model
+    from jepsen_tpu.checker.wgl_native import prep_available
+    from jepsen_tpu.sim import gen_register_history
+
+    h = gen_register_history(
+        random.Random(9), n_ops=100_000, n_procs=5, p_crash=0.0002
+    )
+    ev = history_to_events(h)
+    plan = bs.plan(
+        get_model("cas-register"), ev.window, len(ev.value_codes)
+    )
+    W = plan[0] if plan is not None else (
+        bs.w_bucket(max(ev.window, 1)) or bs.W_BUCKETS[-1]
+    )
+
+    def full_prep(steps_fn):
+        st = steps_fn()
+        for start, end, sw in bs.plan_segments(st):
+            sub = bs._slice_steps(st, start, end, sw)
+            sub = sub.padded(bucket(max(len(sub), 1), 64))
+            bs.pack_steps(sub)
+        return st
+
+    def old_prep():
+        return full_prep(lambda: _events_to_steps_v1(ev, W))
+
+    def new_prep():
+        clear_memos(ev)  # the timed quantity is one cold check's prep
+        return full_prep(lambda: events_to_steps(ev, W=W))
+
+    st_old = old_prep()
+    st_new = new_prep()
+    for fld in ("occ", "f", "a", "b", "slot", "crashed", "op_index",
+                "fresh"):
+        import numpy as _np
+
+        a = getattr(st_old, fld)
+        b = getattr(st_new, fld)
+        assert _np.array_equal(a, b), f"prep paths diverge on {fld}"
+    old_wall, _ = _time(old_prep, reps=3)
+    new_wall, _ = _time(new_prep, reps=3)
+    out = {
+        "n_history_ops": len(h),
+        "n_ops": ev.n_ops,
+        "W": W,
+        "old_wall_s": round(old_wall, 4),
+        "new_wall_s": round(new_wall, 4),
+        "speedup": round(old_wall / new_wall, 2),
+        "native": prep_available(),
+    }
+    print(
+        f"host_prep (events_to_steps+plan+pack, {ev.n_ops} ops, "
+        f"W={W}): old={old_wall:.3f}s new={new_wall:.3f}s "
+        f"speedup={out['speedup']}x native={out['native']}",
+        file=sys.stderr,
+    )
+    return out
 
 
 # -- reduction configs (3, 4, 5) ---------------------------------------------
@@ -551,6 +701,15 @@ def bench_config5():
 # -- engine statistics (VERDICT r3 #9) ---------------------------------------
 
 
+def _launch_stats():
+    """Cumulative host->device dispatch counts for the whole bench run
+    (wgl_bitset.LAUNCH_STATS): how many launches the tunnel actually
+    paid, and how many fast-tier deaths escalated to the exact kernel."""
+    from jepsen_tpu.checker.wgl_bitset import LAUNCH_STATS
+
+    return dict(LAUNCH_STATS)
+
+
 def _engine_stats(register_configs):
     """Aggregate which engine decided each key, window distribution,
     escalations, taints — the measured ladder/envelope behavior
@@ -682,7 +841,17 @@ def main() -> None:
     if _pin:
         jax.config.update("jax_platforms", _pin)
 
-    register_configs, pipeline = bench_register_plane()
+    if "--profile" in sys.argv:
+        # Device-trace the register plane (utils/profiling.trace):
+        # xla-trace/ lands next to the bench cwd for TensorBoard /
+        # Perfetto inspection of the segment chain + batch launches.
+        from jepsen_tpu.utils.profiling import trace
+
+        with trace("xla-trace"):
+            register_configs, pipeline = bench_register_plane()
+    else:
+        register_configs, pipeline = bench_register_plane()
+    host_prep = bench_host_prep()
     configs = register_configs + [
         bench_config3(),
         bench_config4(),
@@ -727,6 +896,8 @@ def main() -> None:
             file=sys.stderr,
         )
     stats = _engine_stats(register_configs)
+    stats["race"] = pipeline.get("race")
+    stats["launch"] = _launch_stats()
     print(f"engine_stats: {json.dumps(stats)}", file=sys.stderr)
 
     # Measure the host<->device round-trip floor: under the axon tunnel
@@ -798,10 +969,18 @@ def main() -> None:
                 # floor — subtraction would fabricate a speedup),
                 # so round-over-round comparisons survive
                 # tunnel-weather changes without digging in stderr.
+                # pipelined_wall_s: the cumulative wall this config
+                # observes riding the shared one-sync dispatch train
+                # (register configs only). vs_baseline_keyadj: the
+                # baseline divided by min(n_keys, 32) before the ratio
+                # — what the "32-core knossos" comparison concedes to
+                # CPU key-parallelism (independent.clj:266-288; keys
+                # beyond 32 can't each have a core).
                 "configs": [
                     {
                         "name": c["name"],
                         "n_ops": c["n_ops"],
+                        "n_keys": c.get("n_keys", 1),
                         "tpu_wall_s": round(c["tpu_wall"], 4),
                         "baseline_wall_s": round(c["oracle_wall"], 4),
                         "python_wall_s": (
@@ -817,6 +996,20 @@ def main() -> None:
                         "speedup": round(
                             c["oracle_wall"] / c["tpu_wall"], 2
                         ),
+                        "vs_baseline_keyadj": round(
+                            (c["oracle_wall"]
+                             / min(c.get("n_keys", 1), 32))
+                            / c["tpu_wall"],
+                            2,
+                        ),
+                        "pipelined_wall_s": (
+                            round(
+                                pipeline["config_walls"][c["name"]], 4
+                            )
+                            if pipeline.get("config_walls")
+                            and c["name"] in pipeline["config_walls"]
+                            else None
+                        ),
                         "floor_subtracted_wall_s": (
                             round(c["tpu_wall"] - rt, 4)
                             if c["tpu_wall"] - rt > rt * 0.1
@@ -825,6 +1018,7 @@ def main() -> None:
                     }
                     for c in configs
                 ],
+                "host_prep": host_prep,
                 "engine_stats": stats,
             }
         )

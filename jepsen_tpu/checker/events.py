@@ -216,8 +216,8 @@ def crashed_invokes(events: EventStream) -> np.ndarray:
 #: every derived-artifact cache attribute memo_on manages (cleared as
 #: a set by clear_memos)
 MEMO_ATTRS = (
-    "_steps_cache", "_seg_args", "_padded_single", "_bitset_args",
-    "_pallas_args", "_death_frontier",
+    "_steps_cache", "_seg_args", "_seg_plan", "_padded_single",
+    "_batch_args", "_bitset_args", "_pallas_args", "_death_frontier",
 )
 
 
@@ -264,18 +264,24 @@ def clear_memos(obj) -> None:
                 pass
 
 
+#: compiled (C++) prep fast path toggle: True tries the native helper
+#: first and falls back to the fused numpy path when the toolchain is
+#: missing. Differential tests flip this to pin both paths.
+PREP_NATIVE = True
+
+
 def events_to_steps(events: EventStream, W: int) -> ReturnSteps:
     """Precompile an event stream into per-return window snapshots.
     Memoized per (events, W): the precompile is a pure function of the
     immutable stream, so escalations, analyze re-runs, and batch paths
-    share one copy.
+    share one copy — a re-check of the same stream pays zero prep.
 
-    Vectorized (no per-event Python loop): per-slot last-writer indices
-    come from a masked np.maximum.accumulate forward fill, window
-    snapshots are row-gathers of the filled arrays at (return_pos - 1),
-    and the monotone crashed mask is a np.bitwise_or.accumulate. A 100k
-    op history precompiles in tens of milliseconds; the memo makes the
-    cost once-per-stream.
+    Two implementations produce byte-identical ReturnSteps: a compiled
+    C++ single pass (resources/wgl_prep.cc, loaded like the native
+    oracle) and the fused numpy fallback (_events_to_steps_numpy) —
+    one scatter + forward fill over [n_ret, W] step rows instead of
+    the event-length intermediates the round-5 version built
+    (_events_to_steps_v1, kept as the microbench anchor).
     """
     if events.window > W:
         raise ValueError(f"window {events.window} exceeds W={W}")
@@ -284,22 +290,150 @@ def events_to_steps(events: EventStream, W: int) -> ReturnSteps:
     )
 
 
+def _empty_steps(events: EventStream, W: int) -> ReturnSteps:
+    nw = n_words(W)
+    return ReturnSteps(
+        occ=np.zeros((0, W), bool),
+        f=np.zeros((0, W), np.int32),
+        a=np.zeros((0, W), np.int32),
+        b=np.zeros((0, W), np.int32),
+        slot=np.zeros(0, np.int32),
+        live=np.zeros(0, bool),
+        crashed=np.zeros((0, nw), np.int32),
+        op_index=np.zeros(0, np.int32),
+        init_state=events.init_state,
+        W=W,
+    )
+
+
 def _events_to_steps(events: EventStream, W: int) -> ReturnSteps:
+    if len(events) == 0:
+        return _empty_steps(events, W)
+    if PREP_NATIVE:
+        from jepsen_tpu.checker.wgl_native import prep_steps_native
+
+        st = prep_steps_native(events, W)
+        if st is not None:
+            return st
+    return _events_to_steps_numpy(events, W)
+
+
+def _events_to_steps_numpy(events: EventStream, W: int) -> ReturnSteps:
+    """Fused vectorized prep: every pass works on [n_ret, W] STEP rows
+    (n_ret = number of returns), never on event-length matrices. Slot
+    writes scatter directly into step space — an invoke lands in the
+    step of the first return after it, a return frees its slot from the
+    next step on — and one masked np.maximum.accumulate forward-fills
+    the last writer per (step, slot). Collisions inside a step cell
+    resolve by scatter order: the freeing return opens the gap, so a
+    re-acquiring invoke (written second) wins, and a slot sees at most
+    one invoke per inter-return gap (it must be freed in between)."""
     nw = n_words(W)
     n = len(events)
     if n == 0:
-        return ReturnSteps(
-            occ=np.zeros((0, W), bool),
-            f=np.zeros((0, W), np.int32),
-            a=np.zeros((0, W), np.int32),
-            b=np.zeros((0, W), np.int32),
-            slot=np.zeros(0, np.int32),
-            live=np.zeros(0, bool),
-            crashed=np.zeros((0, nw), np.int32),
-            op_index=np.zeros(0, np.int32),
-            init_state=events.init_state,
-            W=W,
-        )
+        return _empty_steps(events, W)
+    kind = events.kind
+    slot = events.slot
+    is_inv = kind == EV_INVOKE
+    is_ret = kind == EV_RETURN
+    ret_pos = np.nonzero(is_ret)[0]
+    n_ret = int(ret_pos.shape[0])
+    inv_pos = np.nonzero(is_inv)[0]
+    # Step of each invoke: first return at-or-after it (invoke
+    # positions are never return positions, so 'left' == 'right').
+    step_of = np.searchsorted(ret_pos, inv_pos, side="left")
+    keep = step_of < n_ret
+    r_i = step_of[keep]
+    c_i = slot[inv_pos[keep]]
+
+    # Last-writer forward fill over step rows. Scatter clears first,
+    # invokes second (see docstring for why invoke wins the cell).
+    wrow = np.full((n_ret, W), -1, np.int32)
+    rows = np.arange(1, n_ret, dtype=np.int32)
+    wrow[rows, slot[ret_pos[:-1]]] = rows  # return j frees at row j+1
+    wrow[r_i, c_i] = r_i.astype(np.int32)
+    occ_w = np.zeros((n_ret, W), np.int8)
+    f_w = np.zeros((n_ret, W), np.int32)
+    a_w = np.zeros((n_ret, W), np.int32)
+    b_w = np.zeros((n_ret, W), np.int32)
+    occ_w[r_i, c_i] = 1
+    f_w[r_i, c_i] = events.f[inv_pos[keep]]
+    a_w[r_i, c_i] = events.a[inv_pos[keep]]
+    b_w[r_i, c_i] = events.b[inv_pos[keep]]
+    last = np.maximum.accumulate(wrow, axis=0)
+    valid = last >= 0
+    g = np.where(valid, last, 0)
+    cols = np.arange(W)[None, :]
+    out_occ = valid & (occ_w[g, cols] == 1)
+    out_f = np.where(out_occ, f_w[g, cols], 0).astype(np.int32)
+    out_a = np.where(out_occ, a_w[g, cols], 0).astype(np.int32)
+    out_b = np.where(out_occ, b_w[g, cols], 0).astype(np.int32)
+
+    # Crashed slots: more invokes than returns on the slot (crashed
+    # slots are never recycled, so the unreturned invoke is its LAST
+    # event); the crash bit turns on at that invoke's step.
+    n_inv_s = np.bincount(c_full := slot[inv_pos], minlength=W)
+    n_ret_s = np.bincount(slot[ret_pos], minlength=W)
+    crashed_slots = np.nonzero(n_inv_s > n_ret_s)[0]
+    out_crash = np.zeros((n_ret, nw), np.int32)
+    if len(crashed_slots):
+        # last invoke position per slot: in-order fancy assignment,
+        # later (larger) positions overwrite earlier ones
+        last_inv = np.full(W, -1, np.int64)
+        last_inv[c_full] = inv_pos
+        bits = slot_bit_table(W)
+        for s in crashed_slots:
+            r = int(np.searchsorted(ret_pos, last_inv[s], side="left"))
+            if r < n_ret:
+                out_crash[r] |= bits[s]
+        np.bitwise_or.accumulate(out_crash, axis=0, out=out_crash)
+
+    out_slot = slot[ret_pos].astype(np.int32)
+    if events.op_index is not None:
+        out_opidx = events.op_index[ret_pos].astype(np.int32)
+    else:
+        out_opidx = np.full(n_ret, -1, np.int32)
+
+    # Fresh mask per step: one bincount per mask word with power-of-two
+    # weights. Exact because each slot contributes at most one invoke
+    # per step (distinct powers of two sum without carries, and the
+    # per-word total < 2^32 is exactly representable in float64).
+    out_fresh = np.zeros((n_ret, nw), np.int32)
+    if len(r_i):
+        word_of = c_i >> 5
+        bit_of = np.ldexp(1.0, (c_i & 31).astype(np.int32))
+        for w in range(nw):
+            wts = np.where(word_of == w, bit_of, 0.0)
+            out_fresh[:, w] = (
+                np.bincount(r_i, weights=wts, minlength=n_ret)
+                .astype(np.uint32)
+                .view(np.int32)
+            )
+    return ReturnSteps(
+        occ=out_occ,
+        f=out_f,
+        a=out_a,
+        b=out_b,
+        slot=out_slot,
+        live=np.ones(n_ret, bool),
+        crashed=out_crash,
+        op_index=out_opidx,
+        init_state=events.init_state,
+        W=W,
+        fresh=out_fresh,
+    )
+
+
+def _events_to_steps_v1(events: EventStream, W: int) -> ReturnSteps:
+    """Round-5 vectorized implementation, kept as the host-prep
+    microbench baseline (bench.bench_host_prep) and a third
+    differential anchor: per-slot last-writer indices over the FULL
+    event axis ([n, W] int64 maximum.accumulate), row-gathers at
+    (return_pos - 1), np.bitwise_or.at for the fresh mask."""
+    nw = n_words(W)
+    n = len(events)
+    if n == 0:
+        return _empty_steps(events, W)
 
     kind = events.kind
     slot = events.slot

@@ -356,6 +356,7 @@ def check_events_bucketed(
     model: str = "cas-register",
     k_ladder=K_LADDER,
     race: Optional[bool] = None,
+    interpret: bool = False,
 ) -> dict:
     """Definite linearizability verdict for an event stream.
 
@@ -367,6 +368,10 @@ def check_events_bucketed(
     128-144). Default: on for streams the native envelope covers and
     small enough that the losing thread's overrun is bounded
     (RACE_MAX_OPS). Pass False for pure-TPU measurement runs.
+
+    interpret: run the bitset kernel in Pallas interpret mode on CPU —
+    the tests' seam for exercising the device branch (race logic,
+    launch accounting, escalation) without a TPU.
     """
     from jepsen_tpu.checker.models import model as get_model
 
@@ -379,7 +384,11 @@ def check_events_bucketed(
     # module docstring). taint is impossible by construction; if it ever
     # fires, fall through to the capacity-ladder paths below.
     racer = None  # one native racer serves bitset AND ladder tiers
-    plan = _bitset_plan(events, m) if _on_tpu() else None
+    plan = (
+        _bitset_plan(events, m)
+        if (_on_tpu() or interpret)
+        else None
+    )
     if plan is not None:
         from jepsen_tpu.checker.wgl_bitset import (
             collect_steps_bitset_segmented,
@@ -391,7 +400,9 @@ def check_events_bucketed(
         # Segment-aware: the prefix before crashes widen the window
         # runs on the narrow (16x cheaper) kernel; padding/bucketing
         # happens per segment inside.
-        handle = launch_steps_bitset_segmented(bsteps, model=model, S=S)
+        handle = launch_steps_bitset_segmented(
+            bsteps, model=model, S=S, interpret=interpret
+        )
         if race is None:
             race = _race_eligible(events, m)
         if race:
@@ -408,6 +419,10 @@ def check_events_bucketed(
         )
         if racer is not None:
             _race_crosscheck(racer, alive)
+            # The crosscheck consumed this racer's verdict (counted a
+            # tpu_win): drop it so the taint fall-through below can't
+            # hand the same finish to the K-ladder and double-count.
+            racer = None
         if not taint:
             out = {
                 "valid?": alive,
@@ -582,6 +597,23 @@ def split_queue_history_by_value(history):
     whose per-value enqueue count fits a nibble rides the packed
     kernels — the value-domain bound disappears entirely
     (models.PACKED_QUEUE_MAX_CODES no longer limits whole histories).
+
+    Substreams are rebuilt in ONE pass over the original history
+    order: every invoke and completion lands at its own real-time
+    position. (An earlier version appended each completion right after
+    its invoke, which serialized the substream in invocation order —
+    an overlapping enq/deq pair lost its concurrency and a valid
+    history could report a false violation.) Drain-expansion synthetic
+    dequeues invoke at the drain's invoke position and complete at the
+    drain's completion position — the exact interval the batch
+    occupied. Each synthetic pair gets a UNIQUE INTEGER process:
+    History.pairs matches invoke->completion by process, so two
+    expansion pairs sharing the drain's process would corrupt pairing,
+    and the encoder (history_to_events) drops any op whose process is
+    not an int (is_client_op), so non-int synthetics would silently
+    vanish from the check. Fresh processes are drawn counting DOWN
+    from below the smallest real integer process, so they can never
+    collide with a live client.
     """
     import itertools
     from collections import defaultdict
@@ -591,53 +623,86 @@ def split_queue_history_by_value(history):
 
     subs = defaultdict(list)
     synth = itertools.count(len(history))
+    synth_proc = itertools.count(
+        min(
+            (op.process for op in history
+             if isinstance(op.process, int)),
+            default=0,
+        ) - 1,
+        -1,
+    )
+    #: drain completion index -> [(value, synthetic ok), ...] queued
+    #: for emission when the walk reaches the completion's position
+    drain_oks: dict = {}
     for op in history:
-        if not op.is_invoke:
-            continue
-        comp = history.completion(op)
-        if op.f == "drain":
-            # Drain = a batch of dequeues in one interval. Expansion
-            # into per-value dequeue pairs is EXACT for the unordered
-            # queue (the total-queue expansion discipline,
-            # checker.clj:570-629): removals only shrink enabledness,
-            # so any witness using a mid-drain state has an equivalent
-            # one using the pre-drain state — atomicity of the batch
-            # constrains nothing observable. A crashed drain's values
-            # are unknown and removal-only: vacuous, dropped.
-            if comp is not None and comp.type == "ok":
-                for v in comp.value or ():
-                    if v is None:
-                        return None
-                    # Unique synthetic indices: a drain of [a, a]
-                    # contributes two pairs to subs[a], and duplicate
-                    # indices would corrupt the substream's pairing.
-                    # (They no longer name a real history op; failure
-                    # reports cite the drain via failed_value.)
-                    subs[v].append(op.with_(
-                        f="dequeue", value=None, index=next(synth)
-                    ))
-                    subs[v].append(comp.with_(
-                        f="dequeue", value=v, index=next(synth)
-                    ))
-            continue
-        fcode = QUEUE_F_NAMES.get(op.f)
-        if fcode is None:
-            return None  # not a pure enqueue/dequeue history
-        if fcode == F_ENQ:
-            v = op.value
+        if op.is_invoke:
+            comp = history.completion(op)
+            if op.f == "drain":
+                # Drain = a batch of dequeues in one interval.
+                # Expansion into per-value dequeue pairs is EXACT for
+                # the unordered queue (the total-queue expansion
+                # discipline, checker.clj:570-629): removals only
+                # shrink enabledness, so any witness using a mid-drain
+                # state has an equivalent one using the pre-drain
+                # state — atomicity of the batch constrains nothing
+                # observable. A crashed drain's values are unknown and
+                # removal-only: vacuous, dropped.
+                if comp is not None and comp.type == "ok":
+                    for v in comp.value or ():
+                        if v is None:
+                            return None
+                        proc = next(synth_proc)
+                        subs[v].append(op.with_(
+                            f="dequeue", value=None,
+                            index=next(synth), process=proc,
+                        ))
+                        drain_oks.setdefault(comp.index, []).append((
+                            v,
+                            comp.with_(
+                                f="dequeue", value=v,
+                                index=next(synth), process=proc,
+                            ),
+                        ))
+                continue
+            fcode = QUEUE_F_NAMES.get(op.f)
+            if fcode is None:
+                return None  # not a pure enqueue/dequeue history
+            if fcode == F_ENQ:
+                v = op.value
+            else:
+                v = (
+                    comp.value
+                    if comp is not None and comp.type == "ok"
+                    else None
+                )
+            if v is None:
+                if fcode == F_DEQ:
+                    continue  # NIL dequeue: vacuous (docstring)
+                return None  # enqueue of nil: keep the joint path
+            subs[v].append(op)
         else:
-            v = (
-                comp.value
-                if comp is not None and comp.type == "ok"
-                else None
-            )
-        if v is None:
-            if fcode == F_DEQ:
-                continue  # NIL dequeue: vacuous (docstring)
-            return None  # enqueue of nil: keep the joint tuple path
-        subs[v].append(op)
-        if comp is not None:
-            subs[v].append(comp)
+            if op.f == "drain":
+                for v, ok_op in drain_oks.pop(op.index, ()):
+                    subs[v].append(ok_op)
+                continue
+            fcode = QUEUE_F_NAMES.get(op.f)
+            if fcode is None:
+                return None
+            inv = history.invocation(op)
+            if inv is None:
+                continue  # stray completion: nothing to pair with
+            if fcode == F_ENQ:
+                v = inv.value
+                if v is None:
+                    return None
+            else:
+                # dequeue: only ok completions name a value; a
+                # fail/info dequeue's invoke was dropped as vacuous,
+                # so its completion drops with it.
+                v = op.value if op.type == "ok" else None
+                if v is None:
+                    continue
+            subs[v].append(op)
     return {
         v: History(ops, indexed=True) for v, ops in subs.items()
     }

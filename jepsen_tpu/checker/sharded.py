@@ -162,13 +162,21 @@ def check_keys(
     model: str = "cas-register",
     mesh: Optional[Mesh] = None,
     k_ladder=K_LADDER,
+    interpret: bool = False,
 ) -> List[dict]:
     """Check many independent per-key event streams at once.
 
     With a mesh, keys shard across devices (padded to a multiple of the
-    mesh size); without, the vmap batch runs on one device. Keys whose
-    False verdict is tainted by frontier overflow re-check individually
-    through the escalation ladder / oracle.
+    mesh size); without, the DEFAULT path is the exact bitset batch:
+    one kernel launch, one host sync for ALL keys (the
+    independent.clj:266-288 role on device — zookeeper-10kx16 pays the
+    tunnel floor once, not 16 times). Keys outside the bitset envelope
+    ride the megakernel batch / vmap ladder. Keys whose False verdict
+    is tainted by frontier overflow re-check individually through the
+    escalation ladder / oracle.
+
+    interpret runs the bitset batch in Pallas interpret mode on CPU —
+    the tests' seam for pinning the one-launch contract without a TPU.
     """
     n_real = len(streams)
     if n_real == 0:
@@ -197,6 +205,7 @@ def check_keys(
             kernel_res = check_keys(
                 [streams[i] for i in ok_idx],
                 model=m.packed_variant, mesh=mesh, k_ladder=k_ladder,
+                interpret=interpret,
             )
             verdicts, meta = check_streams(
                 [streams[i] for i in bad_idx], model=model
@@ -240,7 +249,7 @@ def check_keys(
         from jepsen_tpu.checker.linearizable import _on_tpu, _pallas_ok
         from jepsen_tpu.checker.events import n_words
 
-        if _on_tpu():
+        if _on_tpu() or interpret:
             # Exact bitset batch first (one launch, one sync, definite
             # verdicts — no per-key escalation): all keys must fit its
             # envelope, sharing the max window/state buckets.
@@ -255,7 +264,9 @@ def check_keys(
             if bplan is not None:
                 bW, S = bplan
                 steps = [events_to_steps(s, W=bW) for s in streams]
-                outs = bs.check_keys_bitset(steps, model=model, S=S)
+                outs = bs.check_keys_bitset(
+                    steps, model=model, S=S, interpret=interpret
+                )
                 if not any(o[1] for o in outs):  # no taint ever
                     res: List[dict] = []
                     for o in outs:

@@ -73,6 +73,12 @@ from jax.experimental.pallas import tpu as pltpu
 from jepsen_tpu.checker.events import ReturnSteps, bucket, memo_on
 from jepsen_tpu.checker.models import model as get_model
 
+# jax renamed TPUCompilerParams -> CompilerParams across releases;
+# accept either so the kernel runs on both sides of the rename.
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
 #: out columns: alive, taint, died op index, rounds total, rounds max
 OUT_COLS = 8
 
@@ -373,6 +379,20 @@ def bitset_words(W: int) -> int:
     return max((1 << W) // 32, MIN_WORDS)
 
 
+#: host-dispatch accounting: "launches" counts host->device dispatches
+#: (a chained multi-segment scan is ONE launch — the whole plan runs
+#: inside one jitted computation), "escalations" counts fast-tier
+#: deaths that re-ran on the exact kernel. Tests assert on these to
+#: pin the one-dispatch-per-plan and one-launch-per-key-batch
+#: contracts; bench.py publishes them in engine_stats.
+LAUNCH_STATS = {"launches": 0, "escalations": 0}
+
+
+def reset_launch_stats() -> None:
+    LAUNCH_STATS["launches"] = 0
+    LAUNCH_STATS["escalations"] = 0
+
+
 def init_frontier(init_state, S: int, W: int) -> np.ndarray:
     """[S, M] fresh-scan frontier: the init-state row, empty mask.
     Built host-side (numpy): eager per-element device ops would pay a
@@ -444,11 +464,43 @@ def _bitset_scan(
             pltpu.VMEM((S, M), jnp.int32),
             pltpu.VMEM((S, M), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")
         ),
         interpret=interpret,
     )(win, meta, fr_in)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("seg_ws", "model_name", "S", "interpret", "exact"),
+)
+def _chain_scan(args, fr0, seg_ws, model_name, S, interpret, exact):
+    """Whole-plan segment chain in ONE jitted computation -> one host
+    dispatch. `args` is the flat (win0, meta0, win1, meta1, ...) tuple
+    of packed device args, seg_ws the per-segment W buckets (static —
+    each distinct plan shape compiles once). The frontier moves
+    between mask spaces on device (_reshape_frontier: widening is a
+    lane pad, narrowing a lane slice), so the W12-19 bucket chain pays
+    zero host round-trips between buckets. Returns every segment's
+    verdict row, final frontier, and input frontier (the input
+    frontiers feed decode/debug paths; the exact re-run restarts from
+    segment 0 regardless — see collect_steps_bitset_segmented)."""
+    outs = []
+    frs = []
+    fr_ins = []
+    fr = fr0
+    for i, W in enumerate(seg_ws):
+        fr = _reshape_frontier(fr, S, bitset_words(W))
+        fr_ins.append(fr)
+        out, fr = _bitset_scan(
+            args[2 * i], args[2 * i + 1], fr,
+            model_name=model_name, S=S, W=W, interpret=interpret,
+            exact=exact,
+        )
+        outs.append(out)
+        frs.append(fr)
+    return tuple(outs), tuple(frs), tuple(fr_ins)
 
 
 def pack_steps(steps: ReturnSteps):
@@ -513,6 +565,7 @@ def check_steps_bitset(
     fr0 = jnp.asarray(init_frontier(steps.init_state, S, steps.W)[None])
 
     def scan(exact_flag):
+        LAUNCH_STATS["launches"] += 1
         return _bitset_scan(
             *args, fr0, model_name=name, S=S, W=steps.W,
             interpret=interpret, exact=exact_flag,
@@ -522,6 +575,7 @@ def check_steps_bitset(
     verdict = _out_to_verdicts(np.asarray(out))[0]
     if not verdict[0] and not exact:
         # fast-tier death is provisional (under-closure): exact decides
+        LAUNCH_STATS["escalations"] += 1
         out, fr = scan(True)
         verdict = _out_to_verdicts(np.asarray(out))[0]
     if not verdict[0]:
@@ -682,27 +736,11 @@ def plan_segments(
     return segs
 
 
-def launch_steps_bitset_segmented(
-    steps: ReturnSteps,
-    model: str = "cas-register",
-    S: int = 8,
-    interpret: bool = False,
-    exact: bool = False,
-):
-    """Dispatch the multi-segment scan WITHOUT the final host fetch:
-    every segment chains through the frontier in/out pair on device
-    (widening is a lane pad, narrowing a lane slice — a narrow mask
-    space is a lane prefix of the wide one), and the returned handle
-    carries each segment's device verdict + death frontier + input
-    frontier for a later collect. By default segments run on the FAST
-    fixed-round kernel; the collect escalates a death to the exact
-    kernel from the dying segment's input frontier onward."""
-    segs = plan_segments(steps)
-    name = model if isinstance(model, str) else model.name
-    fr = jnp.asarray(init_frontier(steps.init_state, S, segs[0][2])[None])
-    outs = []
-    frs = []
-    fr_ins = []
+def _segment_args(steps: ReturnSteps, segs) -> tuple:
+    """Flat (win0, meta0, win1, meta1, ...) packed device args for a
+    plan, each segment memoized on the steps object (re-checks skip
+    slicing/packing/upload entirely — the analyze seam's
+    one-check-per-history pattern pays prep once)."""
 
     def packed(start, end, W):
         sub = _slice_steps(steps, start, end, W)
@@ -710,23 +748,54 @@ def launch_steps_bitset_segmented(
         win, meta = pack_steps(sub)
         return jnp.asarray(win[None]), jnp.asarray(meta[None])
 
+    flat: List = []
     for start, end, W in segs:
-        # per-segment packed device args memoize like _bitset_args:
-        # re-checks skip slicing/packing/upload
-        args = memo_on(
+        flat.extend(memo_on(
             steps, "_seg_args", (start, end, W),
             lambda s=start, e=end, w=W: packed(s, e, w),
-        )
-        fr = _reshape_frontier(fr, S, bitset_words(W))
-        fr_ins.append(fr)
-        out, fr = _bitset_scan(
-            *args, fr,
-            model_name=name, S=S, W=W, interpret=interpret,
-            exact=exact,
-        )
-        outs.append(out)
-        frs.append(fr)
-    return outs, frs, (segs, fr_ins, name, S, interpret, exact)
+        ))
+    return tuple(flat)
+
+
+def _plan_for(steps: ReturnSteps, min_len: int | None):
+    """The memoized segment plan (keyed by min_len so explicit narrow
+    plans in tests don't collide with the default)."""
+    return memo_on(
+        steps, "_seg_plan", min_len, lambda: plan_segments(steps, min_len)
+    )
+
+
+def launch_steps_bitset_segmented(
+    steps: ReturnSteps,
+    model: str = "cas-register",
+    S: int = 8,
+    interpret: bool = False,
+    exact: bool = False,
+    min_len: int | None = None,
+):
+    """Dispatch the multi-segment scan WITHOUT the final host fetch:
+    the ENTIRE plan runs as one jitted computation (_chain_scan) — one
+    host dispatch per plan, with every segment chained through the
+    frontier in/out pair on device (widening is a lane pad, narrowing
+    a lane slice — a narrow mask space is a lane prefix of the wide
+    one). The returned handle carries each segment's device verdict +
+    death frontier + input frontier for a later collect. By default
+    segments run on the FAST fixed-round kernel; the collect escalates
+    a death to the exact kernel."""
+    segs = _plan_for(steps, min_len)
+    name = model if isinstance(model, str) else model.name
+    args = _segment_args(steps, segs)
+    fr0 = jnp.asarray(
+        init_frontier(steps.init_state, S, segs[0][2])[None]
+    )
+    seg_ws = tuple(W for _, _, W in segs)
+    LAUNCH_STATS["launches"] += 1
+    outs, frs, fr_ins = _chain_scan(
+        args, fr0, seg_ws, name, S, interpret, exact
+    )
+    return list(outs), list(frs), (
+        segs, list(fr_ins), name, S, interpret, exact
+    )
 
 
 def collect_steps_bitset_segmented(
@@ -735,9 +804,13 @@ def collect_steps_bitset_segmented(
     """Block on a launch_steps_bitset_segmented handle: one device_get
     for every segment's verdict; the first death wins. A death on the
     fast tier is provisional (its under-closed frontier is a subset of
-    the true one — see _make_kernel), so the dying segment and
-    everything after it re-run on the exact kernel, restarted from the
-    dying segment's recorded input frontier."""
+    the true one — see _make_kernel), so the plan re-runs on the exact
+    kernel — restarted from SEGMENT 0 with a fresh init frontier, not
+    from the dying segment's input frontier: closure is skipped at
+    steps with no fresh invokes, so under-closure introduced before a
+    segment boundary is never repaired downstream, and any fast-tier
+    frontier (fr_ins[k] included) may silently miss configs. Only a
+    from-scratch exact pass makes the invalid verdict definite."""
     outs, frs, (segs, fr_ins, name, S, interpret, exact) = handle
     fetched = jax.device_get(tuple(outs))
     taint = False
@@ -748,26 +821,22 @@ def collect_steps_bitset_segmented(
             if exact:
                 steps._death_frontier = np.asarray(dead_fr)[0]
                 return False, taint, died
-            # exact re-run from the dying segment's input frontier
-            fr = fr_ins[k]
-            for start, end, W in segs[k:]:
-                args = memo_on(steps, "_seg_args", (start, end, W),
-                               lambda: None)
-                assert args is not None  # packed during launch
-                fr = _reshape_frontier(fr, S, bitset_words(W))
-                out2, fr2 = _bitset_scan(
-                    *args, fr,
-                    model_name=name, S=S, W=W, interpret=interpret,
-                    exact=True,
-                )
-                alive2, t2, died2 = _out_to_verdicts(
-                    np.asarray(out2)
-                )[0]
+            LAUNCH_STATS["launches"] += 1
+            LAUNCH_STATS["escalations"] += 1
+            args = _segment_args(steps, segs)  # memo hit: packed above
+            fr0 = jnp.asarray(
+                init_frontier(steps.init_state, S, segs[0][2])[None]
+            )
+            seg_ws = tuple(W for _, _, W in segs)
+            outs2, frs2, _ = _chain_scan(
+                args, fr0, seg_ws, name, S, interpret, True
+            )
+            for o2, f2 in zip(jax.device_get(tuple(outs2)), frs2):
+                alive2, t2, died2 = _out_to_verdicts(np.asarray(o2))[0]
                 taint = taint or t2
                 if not alive2:
-                    steps._death_frontier = np.asarray(fr2)[0]
+                    steps._death_frontier = np.asarray(f2)[0]
                     return False, taint, died2
-                fr = fr2
             return True, taint, -1
     return True, taint, -1
 
@@ -777,14 +846,15 @@ def check_steps_bitset_segmented(
     model: str = "cas-register",
     S: int = 8,
     interpret: bool = False,
+    min_len: int | None = None,
 ) -> Tuple[bool, bool, int]:
     """Multi-segment scan for crash-accumulating histories: the prefix
     runs on the narrowest kernel its windows fit (per-op cost scales
     16x per bucket), widening as crashed slots pile up, all segments
     chained through the frontier in/out pair with NO host sync in
-    between. The host fetches every segment's verdict in one
-    device_get; the first death wins."""
-    segs = plan_segments(steps)
+    between — ONE dispatch for the whole plan. The host fetches every
+    segment's verdict in one device_get; the first death wins."""
+    segs = _plan_for(steps, min_len)
     if len(segs) == 1:
         # Not worth multiple launches: one scan, shape-bucketed. The
         # padded object memoizes on steps so re-checks reuse its
@@ -803,7 +873,8 @@ def check_steps_bitset_segmented(
     return collect_steps_bitset_segmented(
         steps,
         launch_steps_bitset_segmented(
-            steps, model=model, S=S, interpret=interpret
+            steps, model=model, S=S, interpret=interpret,
+            min_len=min_len,
         ),
     )
 
@@ -900,7 +971,11 @@ def launch_keys_bitset(
     W = steps_list[0].W
     wins, metas = [], []
     for st in steps_list:
-        w, m = pack_steps(st.padded(n))
+        # per-key packing memoizes like _seg_args (keyed by the batch
+        # pad length): re-checking the same streams repacks nothing
+        w, m = memo_on(
+            st, "_batch_args", n, lambda s=st: pack_steps(s.padded(n))
+        )
         wins.append(w)
         metas.append(m)
     fr0 = jnp.asarray(np.stack([
@@ -908,6 +983,7 @@ def launch_keys_bitset(
     ]))
     win_j = jnp.asarray(np.stack(wins))
     meta_j = jnp.asarray(np.stack(metas))
+    LAUNCH_STATS["launches"] += 1
     out, _ = _bitset_scan(
         win_j, meta_j, fr0,
         model_name=name,
@@ -930,6 +1006,8 @@ def collect_keys_bitset(handle) -> List[Tuple[bool, bool, int]]:
     # A fast-tier death is provisional: the exact kernel decides. The
     # whole batch re-runs in one launch (device args are already
     # resident; dead keys are rare, so this is the uncommon path).
+    LAUNCH_STATS["launches"] += 1
+    LAUNCH_STATS["escalations"] += 1
     out2, _ = _bitset_scan(
         win_j, meta_j, fr0,
         model_name=name, S=S, W=W, interpret=interpret, exact=True,

@@ -75,6 +75,97 @@ def available() -> bool:
     return _load() is not None
 
 
+# -- compiled events->steps prep (resources/wgl_prep.cc) ---------------------
+
+_SRC_PREP = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "resources", "wgl_prep.cc",
+)
+
+_prep_lib: Any = None
+_prep_tried = False
+
+
+def _load_prep() -> Optional[ctypes.CDLL]:
+    global _prep_lib, _prep_tried
+    if _prep_tried:
+        return _prep_lib
+    _prep_tried = True
+    so = build_shared(_SRC_PREP, "wgl_prep")
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.wgl_prep_steps.restype = ctypes.c_longlong
+    lib.wgl_prep_steps.argtypes = [
+        i32p, i32p, i32p, i32p, i32p,
+        ctypes.c_void_p,  # op_index (int32*) or NULL
+        ctypes.c_longlong, ctypes.c_int32, ctypes.c_int32,
+        u8p, i32p, i32p, i32p, i32p, i32p, i32p, i32p,
+    ]
+    _prep_lib = lib
+    return lib
+
+
+def prep_available() -> bool:
+    return _load_prep() is not None
+
+
+def prep_steps_native(events: EventStream, W: int):
+    """events_to_steps at C++ speed (one O(n) pass, row memcpys per
+    return — see resources/wgl_prep.cc), or None when no toolchain.
+    Output arrays are byte-identical to the numpy paths; the
+    differential tests in tests/test_events_prep.py pin that."""
+    lib = _load_prep()
+    if lib is None:
+        return None
+    from jepsen_tpu.checker.events import (
+        EV_RETURN,
+        ReturnSteps,
+        n_words,
+    )
+
+    n = len(events)
+    nw = n_words(W)
+    n_ret = int(np.sum(events.kind == EV_RETURN))
+    c = lambda arr: np.ascontiguousarray(arr, np.int32)  # noqa: E731
+    out_occ = np.zeros((n_ret, W), np.uint8)
+    out_f = np.zeros((n_ret, W), np.int32)
+    out_a = np.zeros((n_ret, W), np.int32)
+    out_b = np.zeros((n_ret, W), np.int32)
+    out_slot = np.zeros(n_ret, np.int32)
+    out_crash = np.zeros((n_ret, nw), np.int32)
+    out_opidx = np.full(n_ret, -1, np.int32)
+    out_fresh = np.zeros((n_ret, nw), np.int32)
+    opidx = (
+        c(events.op_index) if events.op_index is not None else None
+    )
+    rc = lib.wgl_prep_steps(
+        c(events.kind), c(events.slot), c(events.f), c(events.a),
+        c(events.b),
+        opidx.ctypes.data_as(ctypes.c_void_p) if opidx is not None
+        else None,
+        n, W, nw, out_occ, out_f, out_a, out_b, out_slot, out_crash,
+        out_opidx, out_fresh,
+    )
+    if rc != n_ret:
+        return None  # malformed stream: let the numpy path raise/handle
+    return ReturnSteps(
+        occ=out_occ.view(bool),
+        f=out_f,
+        a=out_a,
+        b=out_b,
+        slot=out_slot,
+        live=np.ones(n_ret, bool),
+        crashed=out_crash,
+        op_index=out_opidx,
+        init_state=events.init_state,
+        W=W,
+        fresh=out_fresh,
+    )
+
+
 def check_events_native(
     events: EventStream,
     model: Any = "cas-register",

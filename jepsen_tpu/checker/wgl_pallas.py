@@ -45,6 +45,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from jepsen_tpu.checker.events import ReturnSteps, slot_bit_table
+from jepsen_tpu.checker.wgl_bitset import _CompilerParams
 from jepsen_tpu.checker.models import model as get_model
 
 #: meta columns: slotbit, live, crashed, op_index, init_state
@@ -314,7 +315,7 @@ def _pallas_scan(win, meta, model_name, K, W, interpret=False):
         # Without the explicit per-dimension semantics Mosaic schedules
         # the 2-D grid with a ~4ms per-iteration stall (measured); with
         # it, iterations pipeline properly (~20x faster end-to-end).
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")
         ),
         interpret=interpret,

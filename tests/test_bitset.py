@@ -81,6 +81,7 @@ def test_empty_history():
     assert alive is True and not taint and died == -1
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("p_crash", [0.0, 0.05, 0.15])
 def test_oracle_parity_random(p_crash):
     """Differential sweep vs the unbounded oracle: the bitset verdict is
@@ -103,6 +104,7 @@ def test_oracle_parity_random(p_crash):
             assert died >= 0
 
 
+@pytest.mark.slow
 def test_died_index_parity_with_jax_kernel():
     """On a definite-False verdict both exact engines must blame the
     same completion (the first RETURN that empties the frontier)."""
@@ -254,6 +256,7 @@ def test_death_artifact_decodes_competing_configs():
     assert 2 in pend or 2 in lin  # the open write-2 shows up either way
 
 
+@pytest.mark.slow
 def test_segmented_scan_parity():
     """Crash-accumulating histories split into a narrow-window prefix
     and a wide suffix chained through the frontier; the combined
@@ -342,3 +345,105 @@ def test_wide_bucket_w17_interpret():
     want = check_events(evb, model="cas-register")
     assert alive is want is False
     assert died == 9
+
+
+def test_chain_plan_single_dispatch():
+    """The whole multi-segment plan is ONE device dispatch: segments
+    chain through the frontier on device (_chain_scan), so a 2-segment
+    plan that stays alive on the fast tier must count exactly one
+    launch and zero escalations."""
+    from jepsen_tpu.checker import wgl_bitset as bs
+    from jepsen_tpu.checker.events import events_to_steps, history_to_events
+    from jepsen_tpu.checker.wgl_oracle import check_events
+
+    ops = []
+    for _ in range(16):  # narrow prefix: exactly one planner chunk
+        ops.append(invoke_op(0, "write", 1))
+        ops.append(ok_op(0, "write", 1))
+    for p in range(5, 18):  # 13 crashed cas widen the final window
+        ops.append(invoke_op(p, "cas", [8, 9]))
+        ops.append(info_op(p, "cas", [8, 9]))
+    ops.append(invoke_op(1, "read"))
+    ops.append(ok_op(1, "read", 1))
+    ev = history_to_events(History(ops))
+    W, S = _plan(ev)
+    steps = events_to_steps(ev, W=W)
+    segs = bs.plan_segments(steps, 1)
+    assert len(segs) >= 2 and segs[0][2] < segs[-1][2]
+    bs.reset_launch_stats()
+    alive, taint, died = bs.check_steps_bitset_segmented(
+        steps, S=S, interpret=True, min_len=1
+    )
+    assert alive is check_events(ev) is True and not taint
+    assert bs.LAUNCH_STATS["launches"] == 1
+    assert bs.LAUNCH_STATS["escalations"] == 0
+
+
+def test_segmented_escalation_restarts_from_segment_zero():
+    """Regression: a provisional fast-tier death in a LATER segment
+    must escalate by re-running the exact kernel from SEGMENT 0 with a
+    fresh init frontier — resuming from the dying segment's input
+    frontier (fr_ins[k]) keeps the fast tier's under-closure (closure
+    is skipped at steps with no fresh invokes, so configs missed
+    before the boundary are never recovered) and still returns a false
+    violation.
+
+    Construction: a cas chain a(1->2), b(2->3), c(3->4), d(write 5)
+    invoked in DECREASING slot order (d=slot0 ... a=slot3) so each
+    closure round advances one link and {5,{a,b,c,d}} only appears in
+    round 3 > FAST_ROUNDS-1; d/a/b return with no fresh invokes in
+    between (closure skipped), leaving the fast frontier without the
+    {5,{c}} survivor at the segment boundary; crashed cas ops widen
+    c's return into a second segment where filtering c kills the fast
+    (and any boundary-resumed exact) frontier."""
+    import jax
+    import numpy as np
+
+    from jepsen_tpu.checker import wgl_bitset as bs
+    from jepsen_tpu.checker.events import events_to_steps, history_to_events
+    from jepsen_tpu.checker.wgl_oracle import check_events
+
+    ops = []
+    for _ in range(13):
+        ops.append(invoke_op(0, "write", 1))
+        ops.append(ok_op(0, "write", 1))
+    ops.append(invoke_op(4, "write", 5))     # d -> slot 0
+    ops.append(invoke_op(3, "cas", [3, 4]))  # c -> slot 1
+    ops.append(invoke_op(2, "cas", [2, 3]))  # b -> slot 2
+    ops.append(invoke_op(1, "cas", [1, 2]))  # a -> slot 3
+    ops.append(ok_op(4, "write", 5))         # filter d (chunk step 13)
+    ops.append(ok_op(1, "cas", [1, 2]))      # filter a — no fresh invokes
+    ops.append(ok_op(2, "cas", [2, 3]))      # filter b — no fresh invokes
+    for p in range(5, 17):  # 12 crashed cas push c's return wide
+        ops.append(invoke_op(p, "cas", [8, 9]))
+        ops.append(info_op(p, "cas", [8, 9]))
+    ops.append(ok_op(3, "cas", [3, 4]))      # filter c in segment 1
+    ev = history_to_events(History(ops))
+    W, S = _plan(ev)
+    steps = events_to_steps(ev, W=W)
+
+    bs.reset_launch_stats()
+    outs, frs, handle = bs.launch_steps_bitset_segmented(
+        steps, S=S, interpret=True, min_len=1
+    )
+    segs, fr_ins, name, S_, _, _ = handle
+    assert len(segs) >= 2
+    # the fast tier's provisional death lands in the LAST segment
+    fast = [bs._out_to_verdicts(np.asarray(o))[0] for o in outs]
+    assert fast[0][0] is True and fast[-1][0] is False
+
+    alive, taint, died = bs.collect_steps_bitset_segmented(
+        steps, (outs, frs, handle)
+    )
+    assert alive is check_events(ev) is True and not taint
+    assert bs.LAUNCH_STATS["escalations"] == 1
+
+    # Pin the bug mechanism itself: the exact kernel resumed from the
+    # fast boundary frontier (the old escalation's resume point) still
+    # dies — only the from-scratch segment-0 restart is sound.
+    outs3, _, _ = bs._chain_scan(
+        bs._segment_args(steps, segs[1:]), fr_ins[1],
+        (segs[1][2],), name, S_, True, True,
+    )
+    bad = bs._out_to_verdicts(np.asarray(jax.device_get(outs3[0])))[0]
+    assert bad[0] is False

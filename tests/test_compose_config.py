@@ -1,0 +1,48 @@
+"""docker/docker-compose.yml validation: the dev harness contract the
+suites assume (5 privileged DB nodes with fixed hostnames n1..n5 plus
+a control container that mounts this repo) — a hostname typo here
+surfaces much later as an opaque SSH failure inside a suite, so pin it
+where it's cheap."""
+
+from pathlib import Path
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+COMPOSE = Path(__file__).resolve().parents[1] / "docker" / "docker-compose.yml"
+NODES = [f"n{i}" for i in range(1, 6)]
+
+
+def _load():
+    with COMPOSE.open() as f:
+        return yaml.safe_load(f)
+
+
+def test_compose_has_five_nodes_and_control():
+    cfg = _load()
+    services = cfg["services"]
+    assert set(services) == set(NODES) | {"control"}
+
+
+def test_node_hostnames_and_privilege():
+    services = _load()["services"]
+    for n in NODES:
+        svc = services[n]
+        # the merge anchor must not leak n1's hostname/name into n2..n5
+        assert svc["hostname"] == n, (n, svc.get("hostname"))
+        assert svc["container_name"] == f"jepsen-{n}"
+        # clock nemeses need privileged containers (header comment)
+        assert svc.get("privileged") is True, n
+        assert "jepsen" in svc.get("networks", []), n
+
+
+def test_control_depends_on_all_nodes_and_mounts_repo():
+    services = _load()["services"]
+    control = services["control"]
+    assert control["hostname"] == "control"
+    assert set(control.get("depends_on", [])) == set(NODES)
+    vols = control.get("volumes", [])
+    assert any(v.endswith(":/jepsen-tpu") for v in vols), vols
+    assert "jepsen" in control.get("networks", [])
+    assert "jepsen" in _load().get("networks", {})

@@ -1,0 +1,144 @@
+"""Differential tests for the fused/native events->steps host prep.
+
+Three implementations must agree: the per-event reference loop
+(events_to_steps_loop), the round-5 vectorized path (_events_to_steps_v1,
+kept as the microbench baseline), and the current dispatcher
+(_events_to_steps_numpy fused single-forward-fill path, plus the
+optional C++ prep in resources/wgl_prep.cc).
+
+Comparison convention (pinned by test_history.py's loop-vs-vectorized
+test): the loop keeps STALE f/a/b values in freed window cells while
+every vectorized path zeroes them, so vs the loop f/a/b compare only on
+occupied cells; among the vectorized paths ALL fields are
+byte-identical — the "identical ReturnSteps" acceptance bar.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checker import events as ev_mod
+from jepsen_tpu.checker.events import (
+    _events_to_steps_numpy,
+    _events_to_steps_v1,
+    events_to_steps,
+    events_to_steps_loop,
+    history_to_events,
+)
+from jepsen_tpu.checker.wgl_native import prep_available, prep_steps_native
+from jepsen_tpu.history.history import History
+from jepsen_tpu.sim import corrupt_history, gen_register_history
+
+FIELDS = ("occ", "f", "a", "b", "slot", "live", "crashed", "op_index",
+          "fresh")
+
+
+def _assert_bytes_equal(x, y, tag):
+    """Byte-level identity across every field (vectorized paths)."""
+    for fld in FIELDS:
+        ax, ay = getattr(x, fld), getattr(y, fld)
+        if ax is None or ay is None:
+            assert ax is None and ay is None, (tag, fld)
+            continue
+        assert ax.dtype == ay.dtype, (tag, fld)
+        assert ax.shape == ay.shape, (tag, fld)
+        assert ax.tobytes() == ay.tobytes(), (tag, fld)
+    assert x.init_state == y.init_state and x.W == y.W, tag
+
+
+def _assert_matches_loop(ref, x, tag):
+    """Loop-reference comparison: f/a/b only on occupied cells."""
+    for fld in ("occ", "slot", "live", "crashed", "op_index", "fresh"):
+        assert np.array_equal(getattr(ref, fld), getattr(x, fld)), (
+            tag, fld,
+        )
+    for fld in ("f", "a", "b"):
+        assert np.array_equal(
+            getattr(ref, fld)[ref.occ], getattr(x, fld)[x.occ]
+        ), (tag, fld)
+
+
+def _streams():
+    out = []
+    for seed in range(25):
+        rng = random.Random(seed)
+        h = gen_register_history(
+            rng,
+            n_ops=rng.choice([30, 120, 400]),
+            n_procs=rng.choice([3, 5, 8]),
+            p_crash=rng.choice([0.0, 0.02, 0.12]),
+        )
+        if seed % 3 == 0:
+            h = corrupt_history(h, rng)
+        out.append(history_to_events(h))
+    return out
+
+
+def test_numpy_matches_v1_and_loop():
+    for i, ev in enumerate(_streams()):
+        for W in (max(ev.window, 1), 32, 48):
+            if ev.window > W:
+                continue
+            ref = events_to_steps_loop(ev, W)
+            v1 = _events_to_steps_v1(ev, W)
+            fused = _events_to_steps_numpy(ev, W)
+            _assert_bytes_equal(v1, fused, (i, W))
+            _assert_matches_loop(ref, fused, (i, W))
+
+
+@pytest.mark.skipif(not prep_available(), reason="no C++ toolchain")
+def test_native_matches_v1_bytes():
+    for i, ev in enumerate(_streams()):
+        for W in (max(ev.window, 1), 32, 48):
+            if ev.window > W:
+                continue
+            nat = prep_steps_native(ev, W)
+            assert nat is not None
+            _assert_bytes_equal(_events_to_steps_v1(ev, W), nat, (i, W))
+
+
+def test_op_index_none_and_empty():
+    ev = _streams()[0]
+    ev.op_index = None
+    v1 = _events_to_steps_v1(ev, 48)
+    _assert_bytes_equal(v1, _events_to_steps_numpy(ev, 48), "opidx")
+    if prep_available():
+        _assert_bytes_equal(v1, prep_steps_native(ev, 48), "opidx-nat")
+    empty = history_to_events(History([]))
+    st = events_to_steps(empty, W=16)
+    assert len(st) == 0 and st.W == 16 and st.fresh is None
+
+
+def test_dispatcher_identical_with_native_disabled(monkeypatch):
+    """events_to_steps returns byte-identical steps whether the native
+    fast path is on or off — flipping PREP_NATIVE can never change a
+    verdict."""
+    ev = _streams()[1]
+    st_on = events_to_steps(ev, W=32)
+    monkeypatch.setattr(ev_mod, "PREP_NATIVE", False)
+    ev_off = history_to_events(
+        gen_register_history(random.Random(1), n_ops=120, n_procs=3,
+                             p_crash=0.02)
+    )
+    # same underlying history as _streams()[1]? Not guaranteed — use
+    # the SAME stream, cleared of memos, so both runs prep from scratch.
+    ev_mod.clear_memos(ev)
+    st_off = events_to_steps(ev, W=32)
+    _assert_bytes_equal(st_on, st_off, "native-flip")
+    assert ev_off is not None  # keep the throwaway stream referenced
+
+
+def test_steps_memoized_per_stream_and_w():
+    """The analyze seam checks one history once per stream object:
+    repeated events_to_steps on the same (events, W) must return the
+    SAME object (zero re-prep), and clear_memos must drop it."""
+    ev = _streams()[2]
+    a = events_to_steps(ev, W=32)
+    b = events_to_steps(ev, W=32)
+    assert a is b
+    c = events_to_steps(ev, W=48)
+    assert c is not a
+    ev_mod.clear_memos(ev)
+    d = events_to_steps(ev, W=32)
+    assert d is not a

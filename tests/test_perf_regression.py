@@ -115,9 +115,43 @@ def test_bench_register_plane_pipelined_interpret():
         etcd = bench._etcd_streams()[:3]
         zk = bench._zk_streams()[:3]
         ns = bench._northstar_stream()
-        ok = bench._register_plane_pipelined(
+        out = bench._register_plane_pipelined(
             etcd, zk, ns, interpret=True
         )
+        assert out is not None
+        ok, walls = out
         assert ok is True
+        # per-config cumulative walls feed the bench JSON's
+        # pipelined_wall_s field — all three configs must report
+        assert set(walls) == {
+            "etcd-1k", "zookeeper-10kx16", "northstar-100k",
+        }
+        assert all(w > 0 for w in walls.values()), walls
     finally:
         bench.SMOKE = old
+
+
+def test_host_prep_2x_on_100k_stream():
+    """The prep acceptance bar: events_to_steps (fused numpy + native
+    fast path) at least 2x faster than the round-5 vectorized baseline
+    (_events_to_steps_v1) on a 100k-op history, with byte-identical
+    ReturnSteps (asserted inside bench_host_prep). Ratio of two walls
+    on the same host — not an absolute-time assertion."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ))
+    import bench
+
+    from jepsen_tpu.checker.wgl_native import prep_available
+
+    if not prep_available():
+        import pytest
+
+        pytest.skip("no C++ toolchain: native prep path unavailable")
+    out = bench.bench_host_prep()
+    assert out["n_history_ops"] >= 100_000
+    assert out["native"] is True
+    assert out["speedup"] >= 2.0, out

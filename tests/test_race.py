@@ -105,3 +105,43 @@ def test_eligibility_gate():
     big = _stream(n_ops=100)
     big.n_ops = lin.RACE_MAX_OPS + 1  # size gate
     assert not lin._race_eligible(big, m)
+
+
+def test_bitset_crosscheck_consumes_racer_no_double_count(monkeypatch):
+    """Regression: after the bitset tier cross-checks its racer, the
+    racer must be DROPPED before the taint fall-through hands control
+    to the K-ladder. The old code kept it, so one native computation
+    was counted twice — a tpu_win at the crosscheck AND a native_win
+    when the ladder saw the already-finished racer. Invariant: every
+    racer decides exactly one race, so tpu_wins + native_wins must
+    equal the number of racers created."""
+    import jepsen_tpu.checker.wgl_bitset as bs
+
+    lin.reset_race_stats()
+    ev = _stream(n_ops=60, seed=11)
+
+    created = []
+    real_racer = lin._NativeRacer
+
+    class CountingRacer(real_racer):
+        def __init__(self, *a, **kw):
+            created.append(self)
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(lin, "_NativeRacer", CountingRacer)
+    # Deterministic ordering: the TPU side always wins the decide, so
+    # the bitset tier reaches its crosscheck.
+    monkeypatch.setattr(lin, "_race_decide", lambda *a, **kw: None)
+    # Force the impossible-by-construction taint so the bitset branch
+    # falls through to the K-ladder after cross-checking.
+    monkeypatch.setattr(
+        bs, "collect_steps_bitset_segmented",
+        lambda steps, handle: (True, True, -1),
+    )
+
+    out = lin.check_events_bucketed(ev, race=True, interpret=True)
+    assert out["valid?"] is True, out
+    assert lin.RACE_STATS["crosschecked"] >= 1
+    wins = lin.RACE_STATS["tpu_wins"] + lin.RACE_STATS["native_wins"]
+    assert wins == len(created), (dict(lin.RACE_STATS), len(created))
+    assert len(created) == 2  # bitset racer dropped; ladder made its own

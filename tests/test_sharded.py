@@ -121,3 +121,39 @@ def test_batch_path_escalation_on_one_device():
     results = check_keys(streams, k_ladder=(2, 128))
     for i, (s, r) in enumerate(zip(streams, results)):
         assert r["valid?"] == oracle_check(s), f"key {i}: {r}"
+
+
+def test_check_keys_bitset_batch_single_launch():
+    """The multi-key default plane: 16 keys ride ONE batched bitset
+    launch + one host sync (the zookeeper-10kx16 shape pays the tunnel
+    floor once, not 16 times). Clean streams never escalate, so the
+    launch counter must read exactly 1."""
+    from jepsen_tpu.checker import wgl_bitset as bs
+
+    streams = _streams(16, corrupt_every=0)
+    bs.reset_launch_stats()
+    results = check_keys(streams, interpret=True)
+    assert len(results) == 16
+    for s, r in zip(streams, results):
+        assert r["method"] == "tpu-wgl-bitset-batch"
+        assert r["valid?"] == oracle_check(s)
+    assert bs.LAUNCH_STATS["launches"] == 1
+    assert bs.LAUNCH_STATS["escalations"] == 0
+
+
+def test_check_keys_bitset_batch_escalation_parity():
+    """Corrupted keys in the batch: a fast-tier death escalates the
+    WHOLE batch to the exact kernel in one more launch (2 total, 1
+    escalation), and every key's verdict still matches the per-key
+    oracle."""
+    from jepsen_tpu.checker import wgl_bitset as bs
+
+    streams = _streams(16, corrupt_every=3)
+    assert not all(oracle_check(s) for s in streams)
+    bs.reset_launch_stats()
+    results = check_keys(streams, interpret=True)
+    for i, (s, r) in enumerate(zip(streams, results)):
+        assert r["method"] == "tpu-wgl-bitset-batch", (i, r)
+        assert r["valid?"] == oracle_check(s), (i, r)
+    assert bs.LAUNCH_STATS["launches"] == 2
+    assert bs.LAUNCH_STATS["escalations"] == 1
