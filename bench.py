@@ -272,10 +272,11 @@ def bench_register_plane():
         file=sys.stderr,
     )
 
-    # Pipelined: one dispatch train, one sync, whole register plane.
-    # Best-effort: a failure here must never kill the bench (the solo
-    # measurements above are the record).
+    # Pipelined: one dispatch plane, one collect train, whole register
+    # suite. Best-effort: a failure here must never kill the bench (the
+    # solo measurements above are the record).
     pipe_walls = None
+    pipe_dstats = None
     try:
         # Smoke on a non-TPU backend still exercises the train (and
         # publishes pipelined walls) via Pallas interpret mode; the
@@ -292,6 +293,7 @@ def bench_register_plane():
         pipe_ok = pipe_out if pipe_out is None else pipe_out[0]
         if pipe_ok:
             pipe_walls = pipe_out[1]
+            pipe_dstats = pipe_out[2]
         if pipe_ok is False:
             print(
                 "WARNING: pipelined register-plane verdicts diverged; "
@@ -361,24 +363,32 @@ def bench_register_plane():
         "n_ops": n_etcd + n_zk + ns.n_ops,
         "available": pipe_ok is not None,
         "config_walls": pipe_walls,
+        "dispatch_stats": pipe_dstats,
         "race": race,
     }
     return configs, pipeline
 
 
 def _register_plane_pipelined(etcd, zk, ns, interpret=False):
-    """Dispatch each register config's kernel work back-to-back — the
-    etcd key batch, the zookeeper key batch, and the north star's
-    segment chain — then sync with one collect train. Returns
-    (ok, walls): ok True when all verdicts hold, and walls a per-config
-    dict of CUMULATIVE time from dispatch start to that config's
-    collect (the pipelined wall each config observes when riding the
-    shared train — the number the bench JSON publishes per config).
-    Returns None when the bitset plan doesn't cover the inputs
-    (non-TPU backend). interpret=True runs the kernels in Pallas
-    interpret mode so tests exercise this exact path on CPU."""
+    """Suite mode: every register config rides ONE DispatchPlane — the
+    8 etcd keys coalesce into one stacked launch, the 16 zookeeper keys
+    into another, the north star dispatches its segment chain solo, and
+    the plane's prep worker overlaps host-side step packing with device
+    execution. One collect train syncs the lot. Returns
+    (ok, walls, dstats): ok True when all verdicts hold, walls a
+    per-config dict of CUMULATIVE time from submit start to that
+    config's resolve (the pipelined wall each config observes riding
+    the shared train — the number the bench JSON publishes), and dstats
+    the plane's dispatch_stats() snapshot for the run (batches formed,
+    occupancy, floor amortization). Returns None when the bitset plan
+    doesn't cover the inputs (non-TPU backend). interpret=True runs the
+    kernels in Pallas interpret mode so tests exercise this exact path
+    on CPU."""
     from jepsen_tpu.checker import wgl_bitset as bs
-    from jepsen_tpu.checker.events import clear_memos, events_to_steps
+    from jepsen_tpu.checker.dispatch import (
+        DispatchPlane, dispatch_stats, reset_dispatch_stats,
+    )
+    from jepsen_tpu.checker.events import clear_memos
     from jepsen_tpu.checker.linearizable import _on_tpu
     from jepsen_tpu.checker.models import model as get_model
 
@@ -394,33 +404,28 @@ def _register_plane_pipelined(etcd, zk, ns, interpret=False):
         return None
     for s in etcd + zk + [ns]:
         clear_memos(s)
-    bW, S = plan
-    t0 = time.perf_counter()
-    etcd_steps = [events_to_steps(s, W=bW) for s in etcd]
-    zk_steps = [events_to_steps(s, W=bW) for s in zk]
-    nsW, nsS = ns_plan
-    ns_steps = events_to_steps(ns, W=nsW)
-    h_etcd = bs.launch_keys_bitset(
-        etcd_steps, model="cas-register", S=S, interpret=interpret
-    )
-    h_zk = bs.launch_keys_bitset(
-        zk_steps, model="cas-register", S=S, interpret=interpret
-    )
-    h_ns = bs.launch_steps_bitset_segmented(
-        ns_steps, model="cas-register", S=nsS, interpret=interpret
-    )
+    reset_dispatch_stats()
     walls = {}
-    etcd_verdicts = bs.collect_keys_bitset(h_etcd)
-    walls["etcd-1k"] = time.perf_counter() - t0
-    zk_verdicts = bs.collect_keys_bitset(h_zk)
-    walls["zookeeper-10kx16"] = time.perf_counter() - t0
-    ns_verdict = bs.collect_steps_bitset_segmented(ns_steps, h_ns)
-    walls["northstar-100k"] = time.perf_counter() - t0
-    ok = all(
-        v[0] and not v[1] for v in etcd_verdicts + zk_verdicts
-    )
-    ok = ok and ns_verdict[0] and not ns_verdict[1]
-    return ok, walls
+    t0 = time.perf_counter()
+    # coalesce window >> prep time: the explicit flush below decides
+    # batching (full occupancy, deterministic dispatch_stats), not the
+    # prep worker's age-based flush.
+    with DispatchPlane(
+        interpret=interpret, async_prep=True,
+        coalesce_wait_us=2_000_000,
+    ) as plane:
+        etcd_futs = [plane.submit(s) for s in etcd]
+        zk_futs = [plane.submit(s) for s in zk]
+        ns_fut = plane.submit(ns)
+        plane.flush()
+        etcd_out = [f.result() for f in etcd_futs]
+        walls["etcd-1k"] = time.perf_counter() - t0
+        zk_out = [f.result() for f in zk_futs]
+        walls["zookeeper-10kx16"] = time.perf_counter() - t0
+        ns_out = ns_fut.result()
+        walls["northstar-100k"] = time.perf_counter() - t0
+    ok = all(o["valid?"] for o in etcd_out + zk_out + [ns_out])
+    return ok, walls, dispatch_stats()
 
 
 def bench_race_parity(streams, expected):
@@ -962,6 +967,11 @@ def main() -> None:
                     if pipeline["available"]
                     else None
                 ),
+                # dispatch_stats: the coalescing plane's accounting for
+                # the suite-mode pass (batches formed, mean occupancy,
+                # floor_amortization = requests served per device sync
+                # — conventions in BENCH_NOTES.md).
+                "dispatch_stats": pipeline.get("dispatch_stats"),
                 "sync_floor_ms": round(rt * 1e3, 1),
                 # Per-config record (VERDICT r4 Weak #7): solo wall,
                 # strongest-CPU baseline, and the floor-subtracted

@@ -21,6 +21,11 @@ _EXPORTS = {
     "compose": "jepsen_tpu.checker.core",
     "concurrency_limit": "jepsen_tpu.checker.core",
     "merge_valid": "jepsen_tpu.checker.core",
+    "CheckFuture": "jepsen_tpu.checker.dispatch",
+    "DispatchPlane": "jepsen_tpu.checker.dispatch",
+    "default_plane": "jepsen_tpu.checker.dispatch",
+    "dispatch_stats": "jepsen_tpu.checker.dispatch",
+    "reset_dispatch_stats": "jepsen_tpu.checker.dispatch",
     "LinearizableChecker": "jepsen_tpu.checker.linearizable",
     "check_events_bucketed": "jepsen_tpu.checker.linearizable",
     "linearizable": "jepsen_tpu.checker.linearizable",

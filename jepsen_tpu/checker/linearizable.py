@@ -28,6 +28,7 @@ the reference's failing-op report, checker.clj:146-154).
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Optional
 
@@ -262,7 +263,10 @@ def _race_eligible(events: EventStream, m) -> bool:
 
 
 #: cumulative race outcomes for observability (bench engine_stats and
-#: run epitaphs read this; reset_race_stats() for tests)
+#: run epitaphs read this; reset_race_stats() for tests). Updated via
+#: _bump_race: races now finish on the dispatch plane's collecting
+#: threads as well as the caller's, and unlocked += drops counts under
+#: that interleaving.
 RACE_STATS = {
     "tpu_wins": 0,
     "native_wins": 0,
@@ -270,10 +274,18 @@ RACE_STATS = {
     "mismatches": 0,
 }
 
+_race_stats_lock = threading.Lock()
+
+
+def _bump_race(key: str, n: int = 1) -> None:
+    with _race_stats_lock:
+        RACE_STATS[key] += n
+
 
 def reset_race_stats() -> None:
-    for k in RACE_STATS:
-        RACE_STATS[k] = 0
+    with _race_stats_lock:
+        for k in RACE_STATS:
+            RACE_STATS[k] = 0
 
 
 def _tpu_handle_ready(handle) -> bool:
@@ -290,7 +302,7 @@ def _native_win_verdict(events, racer, model, escalations=0):
     if racer.error is not None or racer.result is None:
         return None
     valid, stats = racer.result
-    RACE_STATS["native_wins"] += 1
+    _bump_race("native_wins")
     out = {
         "valid?": valid,
         "method": "cpu-oracle-native",
@@ -334,14 +346,14 @@ def _race_crosscheck(racer, tpu_alive: bool) -> None:
     cross-check the verdicts — free production differential coverage.
     A mismatch means an engine bug; it is logged loudly and counted
     (the differential soaks treat any mismatch as a failure)."""
-    RACE_STATS["tpu_wins"] += 1
+    _bump_race("tpu_wins")
     racer.join(0.05)
     if not racer.done() or racer.error or racer.result is None:
         return
-    RACE_STATS["crosschecked"] += 1
+    _bump_race("crosschecked")
     native_valid = racer.result[0]
     if bool(native_valid) != bool(tpu_alive):
-        RACE_STATS["mismatches"] += 1
+        _bump_race("mismatches")
         import logging
 
         logging.getLogger("jepsen_tpu.checker").critical(
@@ -708,12 +720,18 @@ def split_queue_history_by_value(history):
     }
 
 
-def check_queue_by_value(history, model: str, init_value=None):
+def check_queue_by_value(history, model: str, init_value=None,
+                         plane=None):
     """Batched per-value queue check (split_queue_history_by_value),
     or None when the history doesn't decompose / a subhistory blows
     the window. Verdict merge: valid iff every value is; the first
     invalid value re-checks through the joint single-stream machinery
-    for its failure report."""
+    for its failure report.
+
+    plane: a dispatch.DispatchPlane — the per-value substreams submit
+    as individual requests and coalesce with whatever else the plane
+    holds (other keys, other checkers) instead of forming their own
+    private batch; verdict-identical to the check_keys path."""
     subs = split_queue_history_by_value(history)
     if subs is None or not subs:
         return None
@@ -724,9 +742,16 @@ def check_queue_by_value(history, model: str, init_value=None):
         }
     except WindowOverflow:
         return None
-    from jepsen_tpu.checker.sharded import check_keys
+    if plane is not None:
+        futs = [
+            plane.submit(s, model=model) for s in streams.values()
+        ]
+        plane.flush()
+        results = [f.result() for f in futs]
+    else:
+        from jepsen_tpu.checker.sharded import check_keys
 
-    results = check_keys(list(streams.values()), model=model)
+        results = check_keys(list(streams.values()), model=model)
     methods: dict = {}
     for r in results:
         methods[r["method"]] = methods.get(r["method"], 0) + 1
@@ -779,10 +804,44 @@ class LinearizableChecker:
         model: str = "cas-register",
         init_value: Any = None,
         use_tpu: bool = True,
+        plane=None,
     ):
         self.model = model
         self.init_value = init_value
         self.use_tpu = use_tpu
+        # Optional dispatch.DispatchPlane: checks submitted through it
+        # coalesce with concurrent requests (other keys, other checker
+        # instances) into shared device launches instead of paying the
+        # sync floor each. Verdicts are identical either way.
+        self.plane = plane
+
+    def check_async(self, test, history, opts=None):
+        """Submit this history to the configured dispatch plane and
+        return a zero-arg resolver; calling it blocks on the coalesced
+        launch and yields the same dict check() would. Requires plane.
+        Submitting many keys before resolving any lets them share
+        device dispatches (the whole point of the plane)."""
+        if self.plane is None:
+            raise ValueError("check_async requires a dispatch plane")
+        from jepsen_tpu.history.history import History
+
+        if not isinstance(history, History):
+            history = History(history)
+        t0 = time.perf_counter()
+        fut = self.plane.submit_history(
+            history, model=self.model, init_value=self.init_value
+        )
+
+        def resolve() -> dict:
+            out = fut.result()
+            if fut.events is not None:
+                out.setdefault("n_ops", fut.events.n_ops)
+                out.setdefault("window", fut.events.window)
+            out["wall_s"] = time.perf_counter() - t0
+            self._render_failure(test, out, opts)
+            return out
+
+        return resolve
 
     def check(self, test, history, opts=None) -> dict:
         from jepsen_tpu.history.history import History
@@ -796,7 +855,8 @@ class LinearizableChecker:
             # over per-value substreams instead of a joint scan whose
             # packed envelope real value domains immediately exceed.
             out = check_queue_by_value(
-                history, self.model, init_value=self.init_value
+                history, self.model, init_value=self.init_value,
+                plane=self.plane,
             )
             if out is not None:
                 out["n_ops"] = len(history)
@@ -820,7 +880,12 @@ class LinearizableChecker:
             out = _oracle_verdict(*_oracle_decide(events, self.model))
         else:
             if self.use_tpu:
-                out = check_events_bucketed(events, model=self.model)
+                if self.plane is not None:
+                    out = self.plane.submit(
+                        events, model=self.model
+                    ).result()
+                else:
+                    out = check_events_bucketed(events, model=self.model)
             else:
                 out = _oracle_verdict(
                     *_oracle_decide(events, self.model)

@@ -358,6 +358,28 @@ def check_keys(
     died = np.asarray(died)[:n_real]
 
     method = "tpu-wgl-sharded" if mesh is not None else "tpu-wgl-batch"
+    return vmap_verdicts(
+        streams, alive, overflow, died,
+        model=model, k_ladder=k_ladder, K=K, method=method,
+    )
+
+
+def vmap_verdicts(
+    streams,
+    alive,
+    overflow,
+    died,
+    *,
+    model: str,
+    k_ladder,
+    K: int,
+    method: str = "tpu-wgl-batch",
+) -> List[dict]:
+    """Turn a stacked K-frontier launch's (alive, overflow, died)
+    vectors back into per-stream verdict dicts: definite results map
+    directly; overflow-tainted deaths escalate that stream alone up
+    the remaining k_ladder rungs (check_events_bucketed). Shared by
+    check_keys and the dispatch plane's vmap-tier collect."""
     out: List[dict] = []
     for i, s in enumerate(streams):
         if alive[i] or not overflow[i]:
@@ -371,9 +393,12 @@ def check_keys(
                 r["failed_op_index"] = int(died[i])
             out.append(r)
         else:
-            # Overflow-tainted False: escalate this key alone.
+            # Overflow-tainted False: escalate this key alone. The
+            # overflowed batch rung counts toward escalations — the
+            # same tally the solo ladder's in-loop counter reports.
             r = check_events_bucketed(
                 s, model=model, k_ladder=k_ladder[1:] or k_ladder
             )
+            r["escalations"] = r.get("escalations", 0) + 1
             out.append(r)
     return out

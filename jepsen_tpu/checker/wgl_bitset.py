@@ -61,6 +61,7 @@ automaton instead of a JVM graph search.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import List, Tuple
 
 import jax
@@ -384,13 +385,24 @@ def bitset_words(W: int) -> int:
 #: inside one jitted computation), "escalations" counts fast-tier
 #: deaths that re-ran on the exact kernel. Tests assert on these to
 #: pin the one-dispatch-per-plan and one-launch-per-key-batch
-#: contracts; bench.py publishes them in engine_stats.
+#: contracts; bench.py publishes them in engine_stats. Updates go
+#: through _bump_launch: the dispatch plane's prep worker and
+#: collecting callers launch concurrently, and unlocked += would drop
+#: counts under the interleaving.
 LAUNCH_STATS = {"launches": 0, "escalations": 0}
+
+_launch_stats_lock = threading.Lock()
+
+
+def _bump_launch(key: str, n: int = 1) -> None:
+    with _launch_stats_lock:
+        LAUNCH_STATS[key] += n
 
 
 def reset_launch_stats() -> None:
-    LAUNCH_STATS["launches"] = 0
-    LAUNCH_STATS["escalations"] = 0
+    with _launch_stats_lock:
+        LAUNCH_STATS["launches"] = 0
+        LAUNCH_STATS["escalations"] = 0
 
 
 def init_frontier(init_state, S: int, W: int) -> np.ndarray:
@@ -565,7 +577,7 @@ def check_steps_bitset(
     fr0 = jnp.asarray(init_frontier(steps.init_state, S, steps.W)[None])
 
     def scan(exact_flag):
-        LAUNCH_STATS["launches"] += 1
+        _bump_launch("launches")
         return _bitset_scan(
             *args, fr0, model_name=name, S=S, W=steps.W,
             interpret=interpret, exact=exact_flag,
@@ -575,7 +587,7 @@ def check_steps_bitset(
     verdict = _out_to_verdicts(np.asarray(out))[0]
     if not verdict[0] and not exact:
         # fast-tier death is provisional (under-closure): exact decides
-        LAUNCH_STATS["escalations"] += 1
+        _bump_launch("escalations")
         out, fr = scan(True)
         verdict = _out_to_verdicts(np.asarray(out))[0]
     if not verdict[0]:
@@ -789,7 +801,7 @@ def launch_steps_bitset_segmented(
         init_frontier(steps.init_state, S, segs[0][2])[None]
     )
     seg_ws = tuple(W for _, _, W in segs)
-    LAUNCH_STATS["launches"] += 1
+    _bump_launch("launches")
     outs, frs, fr_ins = _chain_scan(
         args, fr0, seg_ws, name, S, interpret, exact
     )
@@ -799,7 +811,7 @@ def launch_steps_bitset_segmented(
 
 
 def collect_steps_bitset_segmented(
-    steps: ReturnSteps, handle
+    steps: ReturnSteps, handle, outs_host=None
 ) -> Tuple[bool, bool, int]:
     """Block on a launch_steps_bitset_segmented handle: one device_get
     for every segment's verdict; the first death wins. A death on the
@@ -810,9 +822,16 @@ def collect_steps_bitset_segmented(
     steps with no fresh invokes, so under-closure introduced before a
     segment boundary is never repaired downstream, and any fast-tier
     frontier (fr_ins[k] included) may silently miss configs. Only a
-    from-scratch exact pass makes the invalid verdict definite."""
+    from-scratch exact pass makes the invalid verdict definite.
+
+    outs_host: the already-fetched host copies of the handle's out
+    arrays — the dispatch plane fetches a whole launch train in one
+    device_get and hands each launch its slice, skipping the per-plan
+    sync here."""
     outs, frs, (segs, fr_ins, name, S, interpret, exact) = handle
-    fetched = jax.device_get(tuple(outs))
+    fetched = (
+        jax.device_get(tuple(outs)) if outs_host is None else outs_host
+    )
     taint = False
     for k, (o, dead_fr) in enumerate(zip(fetched, frs)):
         alive, t, died = _out_to_verdicts(np.asarray(o))[0]
@@ -821,8 +840,8 @@ def collect_steps_bitset_segmented(
             if exact:
                 steps._death_frontier = np.asarray(dead_fr)[0]
                 return False, taint, died
-            LAUNCH_STATS["launches"] += 1
-            LAUNCH_STATS["escalations"] += 1
+            _bump_launch("launches")
+            _bump_launch("escalations")
             args = _segment_args(steps, segs)  # memo hit: packed above
             fr0 = jnp.asarray(
                 init_frontier(steps.init_state, S, segs[0][2])[None]
@@ -983,7 +1002,7 @@ def launch_keys_bitset(
     ]))
     win_j = jnp.asarray(np.stack(wins))
     meta_j = jnp.asarray(np.stack(metas))
-    LAUNCH_STATS["launches"] += 1
+    _bump_launch("launches")
     out, _ = _bitset_scan(
         win_j, meta_j, fr0,
         model_name=name,
@@ -995,19 +1014,25 @@ def launch_keys_bitset(
     return out, (win_j, meta_j, fr0, name, S, W, interpret, exact)
 
 
-def collect_keys_bitset(handle) -> List[Tuple[bool, bool, int]]:
+def collect_keys_bitset(handle, out_host=None) -> List[Tuple[bool, bool, int]]:
     """Block on a launch_keys_bitset handle and decode verdicts,
     re-running the whole batch on the exact kernel if any key's fast
-    verdict was a (provisional) death."""
+    verdict was a (provisional) death.
+
+    out_host: pre-fetched host copy of the handle's out array (the
+    dispatch plane's one-sync-per-train collect); the escalation
+    re-run, when needed, still syncs on its own."""
     out, (win_j, meta_j, fr0, name, S, W, interpret, exact) = handle
-    verdicts = _out_to_verdicts(np.asarray(out))
+    verdicts = _out_to_verdicts(
+        np.asarray(out if out_host is None else out_host)
+    )
     if exact or all(v[0] for v in verdicts):
         return verdicts
     # A fast-tier death is provisional: the exact kernel decides. The
     # whole batch re-runs in one launch (device args are already
     # resident; dead keys are rare, so this is the uncommon path).
-    LAUNCH_STATS["launches"] += 1
-    LAUNCH_STATS["escalations"] += 1
+    _bump_launch("launches")
+    _bump_launch("escalations")
     out2, _ = _bitset_scan(
         win_j, meta_j, fr0,
         model_name=name, S=S, W=W, interpret=interpret, exact=True,
@@ -1025,8 +1050,15 @@ def check_keys_bitset(
     """Batch of per-key checks in ONE kernel launch + host sync (two
     launches when a fast-tier death escalates to the exact kernel).
     All steps must share W; lengths pad to a power-of-two bucket so one
-    compiled kernel serves every batch."""
-    return collect_keys_bitset(
-        launch_keys_bitset(steps_list, model=model, S=S,
-                           interpret=interpret, exact=exact)
+    compiled kernel serves every batch.
+
+    Routed through the process-wide dispatch plane (checker.dispatch):
+    the batch is still exactly one launch (the launch-count contracts
+    above hold unchanged), but it joins the plane's launch train and
+    stats surface, so concurrent callers pipeline behind one another
+    and collect with a shared sync."""
+    from jepsen_tpu.checker.dispatch import default_plane
+
+    return default_plane().run_keys(
+        steps_list, model=model, S=S, interpret=interpret, exact=exact
     )

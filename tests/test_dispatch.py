@@ -1,0 +1,311 @@
+"""Async coalescing dispatch plane: launch-count invariants, verdict
+parity against the sequential engine (heterogeneous batches, escalation
+mid-batch, queue-by-value substreams), prep-worker overlap, stats
+thread-safety, and the prep-memo LRU bound."""
+import random
+import threading
+
+import pytest
+
+from jepsen_tpu.checker import wgl_bitset as bs
+from jepsen_tpu.checker.dispatch import (
+    DISPATCH_STATS,
+    DispatchPlane,
+    _bump,
+    dispatch_stats,
+    reset_dispatch_stats,
+)
+from jepsen_tpu.checker.events import (
+    clear_memos,
+    history_to_events,
+    memo_stats,
+    reset_memo_stats,
+    set_memo_limit,
+)
+from jepsen_tpu.checker.linearizable import (
+    LinearizableChecker,
+    RACE_STATS,
+    _bump_race,
+    check_events_bucketed,
+    check_queue_by_value,
+    reset_race_stats,
+)
+from jepsen_tpu.history.history import History
+from jepsen_tpu.sim import corrupt_history, gen_register_history
+from test_queue_device import gen_queue_history
+
+
+def _register_streams(n, n_ops=80, corrupt_every=0, seed=7000,
+                      p_crash=0.05):
+    streams = []
+    for i in range(n):
+        rng = random.Random(seed + i)
+        h = gen_register_history(
+            rng, n_ops=n_ops, n_procs=4, p_crash=p_crash
+        )
+        if corrupt_every and i % corrupt_every == corrupt_every - 1:
+            h = corrupt_history(h, rng)
+        streams.append(history_to_events(h, model="cas-register"))
+    return streams
+
+
+def _strip(out):
+    """Verdict fields minus the per-run ones (method names the engine
+    variant, wall_s the clock) — the same comparison convention the
+    sharded batch tests use."""
+    return {k: v for k, v in out.items() if k not in ("method", "wall_s")}
+
+
+def test_coalesced_batch_single_launch():
+    """The launch-counter invariant: N same-shape clean requests form
+    ONE bucket and dispatch as ONE stacked device launch (the sync
+    floor paid once for the whole batch, zero escalations). p_crash=0 +
+    n_ops=100 keeps every stream's step count inside one 64-bucket —
+    coalescing is by bucketed shape, not exact length."""
+    streams = _register_streams(8, n_ops=100, p_crash=0.0)
+    bs.reset_launch_stats()
+    reset_dispatch_stats()
+    with DispatchPlane(interpret=True) as plane:
+        futs = [plane.submit(s) for s in streams]
+        plane.flush()
+        outs = [f.result() for f in futs]
+    assert all(o["valid?"] is True for o in outs)
+    assert all(o["method"] == "tpu-wgl-bitset-batch" for o in outs)
+    assert bs.LAUNCH_STATS["launches"] == 1
+    assert bs.LAUNCH_STATS["escalations"] == 0
+    st = dispatch_stats()
+    assert st["requests"] == 8
+    assert st["batches"] == 1
+    assert st["batched_requests"] == 8
+    assert st["solo_launches"] == 0
+    assert st["mean_batch_occupancy"] == 8.0
+    assert st["floor_amortization"] == 8.0
+
+
+def test_heterogeneous_coalescing_differential():
+    """Mixed-model, mixed-shape batch: cas-register streams (one
+    corrupted — a fast-tier death escalates its bucket to the exact
+    kernel MID-BATCH) plus an unordered-queue history's per-value
+    substreams, all submitted to one plane before any resolve. Every
+    verdict must match the sequential check_events_bucketed on every
+    field except method/wall."""
+    regs = _register_streams(6, corrupt_every=3, seed=7100)
+    rng = random.Random(42)
+    qh = History(
+        gen_queue_history(rng, n_ops=160, n_procs=4, n_values=8)
+    )
+
+    seq = [
+        check_events_bucketed(
+            s, model="cas-register", race=False, interpret=True
+        )
+        for s in regs
+    ]
+    assert not all(o["valid?"] for o in seq)  # escalation really fires
+    seq_q = check_queue_by_value(qh, "unordered-queue")
+
+    reset_dispatch_stats()
+    with DispatchPlane(interpret=True) as plane:
+        futs = [plane.submit(s) for s in regs]
+        q_out = check_queue_by_value(qh, "unordered-queue", plane=plane)
+        outs = [f.result() for f in futs]
+    for s, p in zip(seq, outs):
+        assert _strip(s) == _strip(p), (s, p)
+    assert q_out["valid?"] == seq_q["valid?"]
+    st = dispatch_stats()
+    assert st["requests"] > len(regs)  # queue substreams rode the plane
+    assert st["batched_requests"] > 0
+    assert st["fallbacks"] == 0
+
+
+def test_queue_by_value_substreams_coalesce():
+    """A queue history's per-value substreams submit individually and
+    coalesce: same-shape values share ONE stacked launch instead of
+    each paying the sync floor."""
+    rng = random.Random(43)
+    qh = History(
+        gen_queue_history(rng, n_ops=200, n_procs=4, n_values=10)
+    )
+    seq = check_queue_by_value(qh, "unordered-queue")
+    assert seq is not None
+    reset_dispatch_stats()
+    with DispatchPlane(interpret=True) as plane:
+        out = check_queue_by_value(qh, "unordered-queue", plane=plane)
+    assert out["valid?"] == seq["valid?"]
+    st = dispatch_stats()
+    assert st["requests"] >= 2
+    assert st["batches"] >= 1
+    assert st["mean_batch_occupancy"] > 1.0
+
+
+def test_async_prep_worker_parity():
+    """async_prep=True moves host prep onto the plane's worker thread;
+    verdicts (and the single-launch invariant for a uniform batch) are
+    unchanged. The coalesce window is set far above prep time so the
+    worker's age-based flush can't race the burst of submissions and
+    legitimately split the batch."""
+    streams = _register_streams(6, n_ops=100, seed=7000, p_crash=0.0)
+    bs.reset_launch_stats()
+    with DispatchPlane(
+        interpret=True, async_prep=True, coalesce_wait_us=10_000_000
+    ) as plane:
+        futs = [plane.submit(s) for s in streams]
+        plane.flush()
+        outs = [f.result() for f in futs]
+    assert all(o["valid?"] is True for o in outs)
+    assert bs.LAUNCH_STATS["launches"] == 1
+
+
+def test_checker_and_check_async_through_plane():
+    """LinearizableChecker(plane=...) routes check() through the plane;
+    check_async() returns a resolver so many keys can submit before any
+    sync. Verdicts match the plane-less checker."""
+    rng = random.Random(44)
+    hs = [
+        History(gen_register_history(rng, n_ops=100, n_procs=4))
+        for _ in range(4)
+    ]
+    base = LinearizableChecker(model="cas-register")
+    seq = [base.check({}, h) for h in hs]
+    with DispatchPlane(interpret=True) as plane:
+        c = LinearizableChecker(model="cas-register", plane=plane)
+        direct = c.check({}, hs[0])
+        resolvers = [c.check_async({}, h) for h in hs]
+        plane.flush()
+        outs = [r() for r in resolvers]
+    assert direct["valid?"] == seq[0]["valid?"]
+    for s, p in zip(seq, outs):
+        assert s["valid?"] == p["valid?"]
+        assert p["n_ops"] == s["n_ops"]
+        assert p["wall_s"] > 0
+
+
+def test_check_async_requires_plane():
+    c = LinearizableChecker(model="cas-register")
+    with pytest.raises(ValueError):
+        c.check_async({}, History([]))
+
+
+def test_stats_thread_safety_stress():
+    """LAUNCH_STATS / RACE_STATS / DISPATCH_STATS counters are bumped
+    from the prep worker, collector threads, and racer threads at once;
+    under contention no increment may be lost."""
+    N_THREADS, N_BUMPS = 8, 2000
+    bs.reset_launch_stats()
+    reset_race_stats()
+    reset_dispatch_stats()
+
+    def hammer():
+        for _ in range(N_BUMPS):
+            bs._bump_launch("launches")
+            _bump_race("tpu_wins")
+            _bump("requests")
+
+    threads = [threading.Thread(target=hammer) for _ in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert bs.LAUNCH_STATS["launches"] == N_THREADS * N_BUMPS
+    assert RACE_STATS["tpu_wins"] == N_THREADS * N_BUMPS
+    assert DISPATCH_STATS["requests"] == N_THREADS * N_BUMPS
+    bs.reset_launch_stats()
+    reset_race_stats()
+    reset_dispatch_stats()
+
+
+def test_memo_lru_eviction_and_stats():
+    """The prep-memo registry is LRU-bounded: with the limit shrunk,
+    building memos on more streams than the bound evicts the oldest
+    owner's caches (hits/misses/evictions all counted); evicted
+    streams rebuild on the next touch — correctness never depends on
+    retention."""
+    from jepsen_tpu.checker.events import events_to_steps
+
+    streams = _register_streams(6, n_ops=40, seed=7300)
+    for s in streams:
+        clear_memos(s)
+    old = set_memo_limit(3)
+    reset_memo_stats()
+    try:
+        first = events_to_steps(streams[0], W=streams[0].window)
+        for s in streams:
+            events_to_steps(s, W=s.window)
+        st = memo_stats()
+        assert st["misses"] >= 6
+        assert st["evictions"] >= 3
+        # stream 0 was evicted: next touch is a miss that rebuilds
+        assert not hasattr(streams[0], "_steps_cache")
+        again = events_to_steps(streams[0], W=streams[0].window)
+        assert again.occ.shape == first.occ.shape
+        assert again.W == first.W
+        # a warm re-touch is a hit
+        h0 = memo_stats()["hits"]
+        events_to_steps(streams[0], W=streams[0].window)
+        assert memo_stats()["hits"] == h0 + 1
+    finally:
+        set_memo_limit(old)
+        reset_memo_stats()
+
+
+def test_dispatch_stats_derived_fields():
+    """dispatch_stats() publishes the bench's reporting fields: mean
+    batch occupancy, floor amortization (requests per device sync),
+    mean coalesce wait, and the nested launch counters."""
+    reset_dispatch_stats()
+    streams = _register_streams(4, n_ops=100, seed=7000, p_crash=0.0)
+    with DispatchPlane(interpret=True) as plane:
+        futs = [plane.submit(s) for s in streams]
+        plane.flush()
+        [f.result() for f in futs]
+    st = dispatch_stats()
+    for key in (
+        "requests", "batches", "batched_requests", "solo_launches",
+        "fallbacks", "mean_batch_occupancy", "floor_amortization",
+        "mean_coalesce_wait_us", "launch",
+    ):
+        assert key in st, key
+    assert st["floor_amortization"] == 4.0
+    assert isinstance(st["launch"], dict)
+
+
+@pytest.mark.slow
+def test_dispatch_differential_soak():
+    """Heavy differential soak: 40 mixed register streams (clean,
+    corrupted, crash-heavy) + 3 queue histories through one plane with
+    the prep worker on, byte-identical verdicts (minus method/wall) to
+    the sequential engine."""
+    streams = []
+    for i in range(40):
+        rng = random.Random(9000 + i)
+        h = gen_register_history(
+            rng, n_ops=60 + (i % 5) * 30, n_procs=4,
+            p_crash=0.3 if i % 7 == 0 else 0.02,
+        )
+        if i % 4 == 1:
+            h = corrupt_history(h, rng)
+        streams.append(history_to_events(h, model="cas-register"))
+    qhs = [
+        History(gen_queue_history(
+            random.Random(9500 + i), n_ops=120, n_procs=4, n_values=6
+        ))
+        for i in range(3)
+    ]
+    seq = [
+        check_events_bucketed(
+            s, model="cas-register", race=False, interpret=True
+        )
+        for s in streams
+    ]
+    seq_q = [check_queue_by_value(q, "unordered-queue") for q in qhs]
+    with DispatchPlane(interpret=True, async_prep=True) as plane:
+        futs = [plane.submit(s) for s in streams]
+        q_outs = [
+            check_queue_by_value(q, "unordered-queue", plane=plane)
+            for q in qhs
+        ]
+        outs = [f.result() for f in futs]
+    for i, (s, p) in enumerate(zip(seq, outs)):
+        assert _strip(s) == _strip(p), (i, s, p)
+    for s, p in zip(seq_q, q_outs):
+        assert s["valid?"] == p["valid?"]

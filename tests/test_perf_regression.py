@@ -97,10 +97,10 @@ def test_dirty_reads_at_scale():
 
 
 def test_bench_register_plane_pipelined_interpret():
-    """The bench's pipelined dispatch train (one launch for configs
-    1+2 + the north star's segment chain) — exercised on CPU via
-    Pallas interpret mode so the TPU-only path can't bit-rot between
-    driver runs."""
+    """The bench's suite-mode pass (one DispatchPlane coalescing the
+    etcd + zookeeper key batches and the north star's segment chain) —
+    exercised on CPU via Pallas interpret mode so the TPU-only path
+    can't bit-rot between driver runs."""
     import os
     import sys
 
@@ -119,7 +119,7 @@ def test_bench_register_plane_pipelined_interpret():
             etcd, zk, ns, interpret=True
         )
         assert out is not None
-        ok, walls = out
+        ok, walls, dstats = out
         assert ok is True
         # per-config cumulative walls feed the bench JSON's
         # pipelined_wall_s field — all three configs must report
@@ -127,6 +127,18 @@ def test_bench_register_plane_pipelined_interpret():
             "etcd-1k", "zookeeper-10kx16", "northstar-100k",
         }
         assert all(w > 0 for w in walls.values()), walls
+        # dispatch_stats feed the bench JSON: all 7 submits must have
+        # been served by coalesced or solo launches (never the
+        # sequential fallback), and amortization must beat
+        # one-sync-per-request. (Whether the smoke-sized north star
+        # rides a batch or dispatches its segment chain solo depends
+        # on SMOKE sizing — both are valid plans.)
+        assert dstats["requests"] == 7, dstats
+        assert (
+            dstats["batched_requests"] + dstats["solo_launches"] == 7
+        ), dstats
+        assert dstats["fallbacks"] == 0, dstats
+        assert dstats["floor_amortization"] > 1.0, dstats
     finally:
         bench.SMOKE = old
 
