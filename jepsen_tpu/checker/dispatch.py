@@ -322,6 +322,15 @@ class DispatchPlane:
         result()/drain())."""
         self._pump(flush_all=True)
 
+    def flush_for(self, futs) -> None:
+        """Targeted flush: dispatch only the buckets holding these
+        futures (the inbox preps first so queued submissions have
+        bucket keys). Unlike flush(), other submitters' partially
+        filled buckets keep coalescing — the entry for callers that
+        batch their own submissions on a shared plane
+        (check_queue_by_value's per-value substreams)."""
+        self._pump(flush_futs=tuple(futs))
+
     def drain(self) -> None:
         """Flush, then collect the whole launch train (one device_get)
         and resolve every outstanding future, fallbacks included."""
@@ -361,10 +370,11 @@ class DispatchPlane:
                     "dispatch plane prep worker error"
                 )
 
-    def _pump(self, flush_all: bool = False) -> None:
+    def _pump(self, flush_all: bool = False, flush_futs=()) -> None:
         """Prep the inbox, bucket/dispatch each request, and flush
-        full or aged (or, with flush_all, every) buckets. Callable from
-        the worker thread and from any caller needing progress —
+        aged buckets — plus the buckets holding ``flush_futs`` (the
+        targeted flush), or every bucket with ``flush_all``. Callable
+        from the worker thread and from any caller needing progress —
         _pump_lock makes it single-file."""
         with self._pump_lock:
             while True:
@@ -373,11 +383,15 @@ class DispatchPlane:
                         break
                     fut = self._inbox.popleft()
                 self._prep_and_enqueue(fut)
+            # Bucket keys are assigned during prep, so the targets are
+            # read only after the inbox drains.
+            targets = {f.key for f in flush_futs if f.key is not None}
             now = time.perf_counter()
             with self._lock:
                 keys = [
                     k for k, b in self._buckets.items()
-                    if flush_all or now - b.born >= self.coalesce_wait_s
+                    if flush_all or k in targets
+                    or now - b.born >= self.coalesce_wait_s
                 ]
             for k in keys:
                 self._flush_bucket(k)
@@ -581,23 +595,40 @@ class DispatchPlane:
     # -- collection ----------------------------------------------------
 
     def _drive(self, fut: CheckFuture) -> None:
-        """Make enough progress to resolve one future: flush anything
-        still parked, then collect its launch's prefix of the train."""
-        self._pump(flush_all=True)
+        """Make enough progress to resolve one future: prep the inbox,
+        flush the bucket THIS future rides (other submitters' buckets
+        keep coalescing — a result() call must not force-dispatch the
+        whole plane), then collect its launch's prefix of the train."""
+        self._pump(flush_futs=(fut,))
         if fut.done():
             return
         if fut.kind == "fallback":
             self._resolve_fallbacks()
             return
-        if fut.launch is not None:
-            self._collect_upto(fut.launch)
+        while not fut.done():
+            launch = fut.launch
+            if launch is not None:
+                self._collect_upto(launch)
+                return
+            # A concurrent flush (bucket-full trigger on a submitting
+            # thread) popped the bucket but hasn't registered the
+            # launch yet: it either registers or fails the futures.
+            time.sleep(0.0005)
 
     def _collect_upto(self, target: _Launch) -> None:
         """ONE device_get over every unresolved launch up to (and
         including) the target, then resolve their futures. The device
         executes launches FIFO, so once the target's outputs are ready
         the prefix costs nothing extra to fetch — the whole train pays
-        a single sync."""
+        a single sync.
+
+        Resolved launches leave the train immediately and drop their
+        handle/future references: a launch pins its device output
+        arrays and every rider's events/steps, so an append-only train
+        on a long-lived plane (the process-wide default_plane()
+        especially) would grow host+device memory for the life of the
+        run — and degrade this method's index()/prefix scan — without
+        bound."""
         with self._collect_lock:
             if target.resolved:
                 return
@@ -622,17 +653,29 @@ class DispatchPlane:
                             f.racer = None
                             f._resolve(out)
             host = jax.device_get(tuple(L.device_out() for L in prefix))
-            for L, h in zip(prefix, host):
-                try:
-                    self._resolve_launch(L, h)
-                except BaseException as e:  # noqa: BLE001
-                    # A half-resolved launch must not strand siblings
-                    # in result() forever: fail the rest, re-raise.
-                    for f in L.futs:
-                        f._fail(e)
-                    raise
-                finally:
-                    L.resolved = True
+            try:
+                for L, h in zip(prefix, host):
+                    try:
+                        self._resolve_launch(L, h)
+                    except BaseException as e:  # noqa: BLE001
+                        # A half-resolved launch must not strand
+                        # siblings in result() forever: fail the rest,
+                        # re-raise.
+                        for f in L.futs:
+                            f._fail(e)
+                        raise
+                    finally:
+                        L.resolved = True
+                        for f in L.futs:
+                            f.launch = None
+                            f.steps = None
+                        L.futs = []
+                        L.handle = None
+            finally:
+                with self._lock:
+                    self._launched = [
+                        L for L in self._launched if not L.resolved
+                    ]
 
     def _resolve_launch(self, launch: _Launch, host) -> None:
         if launch.kind == "bitset":

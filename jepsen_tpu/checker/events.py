@@ -223,6 +223,14 @@ MEMO_ATTRS = (
     "_batch_args", "_bitset_args", "_pallas_args", "_death_frontier",
 )
 
+#: attrs that carry a one-shot in-flight artifact rather than a
+#: rebuildable cache: LRU eviction leaves them alone (an eviction
+#: landing between a collect writing the death frontier and its
+#: resolver reading it would silently drop the failure report, and
+#: unlike the caches no later lookup rebuilds it). Explicit
+#: clear_memos still drops them.
+_EVICT_KEEP = frozenset({"_death_frontier"})
+
 #: prep-memo accounting: every memo_on lookup counts a hit or a miss;
 #: evictions counts objects whose memos the LRU bound reclaimed.
 MEMO_STATS = {"hits": 0, "misses": 0, "evictions": 0}
@@ -297,7 +305,7 @@ def _evict_over_limit() -> None:
         tgt = ref()
         if tgt is not None:
             MEMO_STATS["evictions"] += 1
-            clear_memos(tgt)
+            clear_memos(tgt, _evicting=True)
 
 
 def memo_on(obj, attr: str, key, factory):
@@ -331,6 +339,11 @@ def memo_on(obj, attr: str, key, factory):
         if cache is None:  # evicted mid-build: reinstall
             cache = {}
             setattr(obj, attr, cache)
+        # Re-register: the eviction that cleared the cache mid-build
+        # also dropped obj from the LRU registry, and an unregistered
+        # owner's memos are unbounded until some later lookup happens
+        # to touch it.
+        _touch_owner(obj)
         cur = cache.get(key)
         if cur is not None:
             return cur  # another thread won: keep identity stable
@@ -338,22 +351,28 @@ def memo_on(obj, attr: str, key, factory):
     return val
 
 
-def clear_memos(obj) -> None:
+def clear_memos(obj, _evicting: bool = False) -> None:
     """Drop every derived-artifact memo from a stream/steps object
     (and recursively from memoized steps), releasing the pinned host
     and device memory. Also deregisters the object from the LRU
-    registry (so explicit clears free registry slots too)."""
+    registry (so explicit clears free registry slots too).
+
+    _evicting: the LRU-driven variant — in-flight artifacts
+    (_EVICT_KEEP) survive, because eviction may land between the
+    writer and the reader of a death frontier."""
     steps_cache = getattr(obj, "_steps_cache", None)
     if isinstance(steps_cache, dict):
         for v in steps_cache.values():
             if v is not obj:
-                clear_memos(v)
+                clear_memos(v, _evicting=_evicting)
     padded = getattr(obj, "_padded_single", None)
     if isinstance(padded, dict):
         for v in padded.values():
             if v is not obj:
-                clear_memos(v)
+                clear_memos(v, _evicting=_evicting)
     for attr in MEMO_ATTRS:
+        if _evicting and attr in _EVICT_KEEP:
+            continue
         if hasattr(obj, attr):
             try:
                 delattr(obj, attr)

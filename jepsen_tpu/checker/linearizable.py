@@ -182,6 +182,25 @@ def _oracle_verdict(valid, stats, failure, **extra) -> dict:
     return out
 
 
+def _harvest_failure(events: EventStream, out: dict, model) -> None:
+    """Attach the failure report to an invalid verdict that arrived
+    index-only (K-frontier rungs, the native oracle, the dispatch
+    plane's batched tiers): re-run the Python oracle and decode its
+    death material in place. Rare and worth the re-run (the reference
+    budgets hours for report writing, checker.clj:155-158). No-op for
+    valid verdicts or ones already carrying a report — every invalid
+    verdict path (check, check_async, queue-by-value) funnels here so
+    _render_failure always has its artifact."""
+    if out.get("valid?") is not False or "failure" in out:
+        return
+    from jepsen_tpu.checker.wgl_oracle import check_events
+
+    _, py_stats = check_events(events, model=model, return_stats=True)
+    failure = oracle_failure_report(events, py_stats, model)
+    if failure is not None:
+        out["failure"] = failure
+
+
 def _oracle_decide(events: EventStream, model):
     """Oracle verdict + (on invalid) the failure report, re-running the
     Python rung when the native one decided (it carries no frontier)."""
@@ -746,7 +765,11 @@ def check_queue_by_value(history, model: str, init_value=None,
         futs = [
             plane.submit(s, model=model) for s in streams.values()
         ]
-        plane.flush()
+        # Targeted: dispatch only our substreams' buckets — a plane-
+        # wide flush would force out other submitters' partially
+        # filled buckets and undercut the coalescing they're parked
+        # for.
+        plane.flush_for(futs)
         results = [f.result() for f in futs]
     else:
         from jepsen_tpu.checker.sharded import check_keys
@@ -774,18 +797,8 @@ def check_queue_by_value(history, model: str, init_value=None,
                 out["failure"] = detail["failure"]
             else:
                 # index-only engine decided (K-frontier rung): harvest
-                # the report from the Python oracle on the one failing
-                # substream (same policy as the checker tail).
-                from jepsen_tpu.checker.wgl_oracle import check_events
-
-                _, py_stats = check_events(
-                    streams[v], model=model, return_stats=True
-                )
-                failure = oracle_failure_report(
-                    streams[v], py_stats, model
-                )
-                if failure is not None:
-                    out["failure"] = failure
+                # the report on the one failing substream.
+                _harvest_failure(streams[v], out, model)
             break
     return out
 
@@ -837,6 +850,11 @@ class LinearizableChecker:
             if fut.events is not None:
                 out.setdefault("n_ops", fut.events.n_ops)
                 out.setdefault("window", fut.events.window)
+                # Same tail as check(): an invalid verdict from an
+                # index-only engine gets its failure report harvested
+                # before the SVG render, so the async path yields the
+                # same dict (and artifact) the synchronous one would.
+                _harvest_failure(fut.events, out, self.model)
             out["wall_s"] = time.perf_counter() - t0
             self._render_failure(test, out, opts)
             return out
@@ -894,20 +912,8 @@ class LinearizableChecker:
         out["window"] = events.window
         # Every invalid verdict carries a failure report: engines that
         # return only the failing index (K-frontier rungs, the native
-        # oracle) get theirs harvested from the Python oracle — rare
-        # and worth the re-run (the reference budgets hours for report
-        # writing, checker.clj:155-158).
-        if out["valid?"] is False and "failure" not in out:
-            from jepsen_tpu.checker.wgl_oracle import check_events
-
-            _, py_stats = check_events(
-                events, model=self.model, return_stats=True
-            )
-            failure = oracle_failure_report(
-                events, py_stats, self.model
-            )
-            if failure is not None:
-                out["failure"] = failure
+        # oracle) get theirs harvested from the Python oracle.
+        _harvest_failure(events, out, self.model)
         out["wall_s"] = time.perf_counter() - t0
         self._render_failure(test, out, opts)
         return out

@@ -269,6 +269,173 @@ def test_dispatch_stats_derived_fields():
     assert isinstance(st["launch"], dict)
 
 
+def test_launch_train_prunes_after_collect():
+    """Resolved launches leave the train and drop their handle/future
+    references: a long-lived plane (the process-wide default
+    especially) must not pin device outputs or rider steps for the
+    life of the run."""
+    streams = _register_streams(4, n_ops=100, p_crash=0.0, seed=7400)
+    with DispatchPlane(interpret=True) as plane:
+        for s in streams:
+            fut = plane.submit(s)
+            plane.flush()
+            launch_before = fut.launch
+            assert fut.result()["valid?"] is True
+            assert fut.launch is None
+            assert fut.steps is None
+            assert launch_before.handle is None
+            assert launch_before.futs == []
+        with plane._lock:
+            assert plane._launched == []
+
+
+@pytest.mark.slow
+def test_targeted_flush_leaves_other_buckets_parked():
+    """result() (and flush_for) dispatch only the bucket the driven
+    future rides: another submitter's partially filled, different-
+    shape bucket keeps coalescing instead of being force-flushed
+    plane-wide."""
+    a = _register_streams(3, n_ops=100, p_crash=0.0, seed=7400)
+    b = _register_streams(3, n_ops=400, p_crash=0.0, seed=7500)
+    with DispatchPlane(
+        interpret=True, coalesce_wait_us=10_000_000
+    ) as plane:
+        fa = [plane.submit(s) for s in a]
+        fb = [plane.submit(s) for s in b]
+        outs_a = [f.result() for f in fa]
+        assert all(o["valid?"] is True for o in outs_a)
+        with plane._lock:
+            parked = sum(
+                len(bk.futs) for bk in plane._buckets.values()
+            )
+        assert parked == len(fb)  # b's bucket still coalescing
+        outs_b = [f.result() for f in fb]
+        assert all(o["valid?"] is True for o in outs_b)
+
+
+def test_drive_flushes_only_own_bucket():
+    """The cheap (no extra kernel shape) half of the targeted-flush
+    contract: resolving one group's futures leaves a different-shape
+    group's bucket parked. The end-to-end version that also resolves
+    the parked group is the slow test below. Group a reuses
+    test_coalesced_batch_single_launch's exact batch shape (8 streams,
+    one 64-bucket) so a suite run pays no extra kernel compile."""
+    a = _register_streams(8, n_ops=100, p_crash=0.0, seed=7000)
+    b = _register_streams(2, n_ops=30, p_crash=0.0, seed=7500)
+    with DispatchPlane(
+        interpret=True, coalesce_wait_us=10_000_000
+    ) as plane:
+        fa = [plane.submit(s) for s in a]
+        fb = [plane.submit(s) for s in b]
+        outs_a = [f.result() for f in fa]
+        assert all(o["valid?"] is True for o in outs_a)
+        with plane._lock:
+            parked = sum(
+                len(bk.futs) for bk in plane._buckets.values()
+            )
+            # Abandon b before close() so tier-1 never pays its
+            # kernel compile — the parked count above is the test.
+            for bk in plane._buckets.values():
+                for f in bk.futs:
+                    f._fail(RuntimeError("abandoned by test"))
+            plane._buckets.clear()
+        assert parked == len(fb)
+
+
+def test_harvest_failure_attaches_report():
+    """_harvest_failure (check/check_async/queue-by-value shared tail)
+    turns an index-only invalid verdict into one carrying the decoded
+    failure report, and leaves valid or already-reported verdicts
+    alone."""
+    from jepsen_tpu.checker.linearizable import _harvest_failure
+
+    rng = random.Random(7650)  # seed pinned invalid by the oracle
+    h = corrupt_history(
+        gen_register_history(rng, n_ops=40, n_procs=3), rng
+    )
+    ev = history_to_events(h, model="cas-register")
+    out = {"valid?": False, "failed_op_index": 3}
+    _harvest_failure(ev, out, "cas-register")
+    assert "failure" in out
+    assert out["failure"]["configs"]
+    untouched = {"valid?": True}
+    _harvest_failure(ev, untouched, "cas-register")
+    assert "failure" not in untouched
+
+
+def test_check_async_invalid_carries_failure_report(tmp_path):
+    """check_async yields the same dict check() would: an invalid
+    verdict resolved through an index-only engine (>32 value codes put
+    the stream outside the bitset envelope, onto the vmap tier) still
+    carries the harvested failure report and renders the SVG."""
+    rng = random.Random(7600)
+    h = gen_register_history(
+        rng, n_ops=200, n_procs=4, n_values=64, p_crash=0.0
+    )
+    h = corrupt_history(h, rng, n_values=64)
+    seq = LinearizableChecker(model="cas-register").check({}, h)
+    assert seq["valid?"] is False  # seed really is corrupted
+    assert "failure" in seq
+    with DispatchPlane(interpret=True) as plane:
+        c = LinearizableChecker(model="cas-register", plane=plane)
+        resolve = c.check_async(
+            {}, h, opts={"subdirectory": str(tmp_path)}
+        )
+        out = resolve()
+    assert out["method"] == "tpu-wgl-batch"  # really the vmap tier
+    assert out["valid?"] is False
+    assert "failure" in out
+    assert out["failed_op_index"] == seq["failed_op_index"]
+    assert "failure_svg" in out
+
+
+def test_eviction_keeps_inflight_death_frontier():
+    """LRU eviction clears rebuildable caches but must leave the
+    in-flight death-frontier artifact alone: it is written by a
+    collect and read once by a resolver, and no later lookup rebuilds
+    it. Explicit clear_memos still drops it."""
+    import numpy as np
+
+    from jepsen_tpu.checker.events import events_to_steps
+
+    s = _register_streams(1, n_ops=40, seed=7700)[0]
+    st = events_to_steps(s, W=s.window)
+    st._death_frontier = np.zeros(1, np.uint32)
+    old = set_memo_limit(0)  # evict every registered owner
+    try:
+        assert not hasattr(s, "_steps_cache")
+        assert hasattr(st, "_death_frontier")
+    finally:
+        set_memo_limit(old)
+    clear_memos(st)
+    assert not hasattr(st, "_death_frontier")
+
+
+def test_memo_reinstall_reregisters_owner():
+    """A cache evicted while its factory runs is reinstalled AND the
+    owner re-registered in the LRU: an unregistered owner's memos
+    would otherwise grow unbounded until some later lookup touched
+    it."""
+    from jepsen_tpu.checker.events import (
+        _memo_lock,
+        _memo_owners,
+        memo_on,
+    )
+
+    class Obj:
+        pass
+
+    o = Obj()
+
+    def factory():
+        clear_memos(o)  # deregisters o mid-build, like an eviction
+        return "v"
+
+    assert memo_on(o, "_bitset_args", None, factory) == "v"
+    with _memo_lock:
+        assert id(o) in _memo_owners
+
+
 @pytest.mark.slow
 def test_dispatch_differential_soak():
     """Heavy differential soak: 40 mixed register streams (clean,
