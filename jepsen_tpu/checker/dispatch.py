@@ -50,6 +50,18 @@ through the plane are identical to the sequential path:
   — resolved by ``check_events_bucketed`` on the collecting thread; the
   oracle pays no tunnel floor, so there is nothing to amortize.
 
+Mesh execution (the per-device scheduler): when more than one device
+is visible (or an explicit mesh is passed) the plane shards every
+coalesced bucket across the mesh — B requests run B/n_devices per chip
+through the shard_map wrappers (sharded.make_sharded_bitset /
+make_sharded_checker), still ONE launch and one sync — and round-robins
+non-coalescible segmented chain-scans onto per-device launch trains
+(launch_steps_bitset_segmented's device commit), so independent
+requests' chains execute concurrently on different chips. DEVICE_STATS
+tracks the per-device launch/request counts; dispatch_stats() derives
+per-device occupancy and floor_amortization from it. Keys are
+independent, so no collectives ever cross chips.
+
 The native-racer competition (linearizable._NativeRacer) stays
 per-request: with ``race=True`` an eligible request's racer starts
 right after its batch dispatches, a racer that finishes before the
@@ -65,6 +77,7 @@ same convention as sharded.check_keys vs the solo checker.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import OrderedDict, deque
@@ -116,16 +129,35 @@ DISPATCH_STATS = {
 
 _stats_lock = threading.Lock()
 
+#: per-device dispatch accounting (the mesh execution plane's view):
+#: device label -> {"launches": dispatches that placed work on this
+#: chip, "requests": requests whose scan ran there}. A mesh-sharded
+#: stacked launch counts 1 launch on EVERY chip (all execute one
+#: shard) and splits its requests by the key_spec block layout; a
+#: round-robin segmented chain counts on its one chip. dispatch_stats
+#: derives per-device occupancy + floor_amortization from this.
+DEVICE_STATS: "OrderedDict[str, dict]" = OrderedDict()
+
 
 def _bump(key: str, n=1) -> None:
     with _stats_lock:
         DISPATCH_STATS[key] += n
 
 
+def _bump_device(label: str, requests: int = 0, launches: int = 0) -> None:
+    with _stats_lock:
+        d = DEVICE_STATS.setdefault(
+            label, {"launches": 0, "requests": 0}
+        )
+        d["launches"] += launches
+        d["requests"] += requests
+
+
 def reset_dispatch_stats() -> None:
     with _stats_lock:
         for k in DISPATCH_STATS:
             DISPATCH_STATS[k] = 0.0 if k == "coalesce_wait_us" else 0
+        DEVICE_STATS.clear()
 
 
 def dispatch_stats() -> dict:
@@ -134,9 +166,17 @@ def dispatch_stats() -> dict:
     floor_amortization: launched requests per launch actually paid —
     the factor by which coalescing divides the tunnel's sync floor
     (1.0 = no amortization, N = N requests rode each round trip).
+
+    per_device: one block per device that received work — its launch
+    and request counts, its own floor_amortization (requests per
+    launch on THAT chip), and occupancy (its share of all launches:
+    1/n_devices everywhere = perfectly balanced mesh). n_devices is
+    the number of devices that actually received work — the bench's
+    one-device guard trips when this reads 1 on a multi-chip host.
     """
     with _stats_lock:
         out = dict(DISPATCH_STATS)
+        per_dev = {k: dict(v) for k, v in DEVICE_STATS.items()}
     launches = out["batches"] + out["solo_launches"]
     carried = out["batched_requests"] + out["solo_launches"]
     out["mean_batch_occupancy"] = (
@@ -148,6 +188,18 @@ def dispatch_stats() -> dict:
         if out["batched_requests"]
         else 0.0
     )
+    total_dev_launches = sum(d["launches"] for d in per_dev.values())
+    for d in per_dev.values():
+        d["floor_amortization"] = (
+            d["requests"] / d["launches"] if d["launches"] else 0.0
+        )
+        d["occupancy"] = (
+            d["launches"] / total_dev_launches
+            if total_dev_launches
+            else 0.0
+        )
+    out["per_device"] = per_dev
+    out["n_devices"] = len(per_dev)
     out["launch"] = dict(bs.LAUNCH_STATS)
     return out
 
@@ -246,6 +298,14 @@ class DispatchPlane:
         explicitly or at result()).
       async_prep: run prep + flush on a worker thread, overlapping host
         prep of request N+1 with device execution of request N.
+      mesh: the execution mesh (sharded.resolve_mesh semantics: None =
+        auto over all visible devices when >1, False = force
+        single-device, a Mesh = explicit). With a mesh the plane is a
+        per-device scheduler: coalesced buckets shard across the mesh
+        (B/n_devices keys per chip, one launch), and non-coalescible
+        segmented chain-scans round-robin onto per-device launch
+        trains so independent requests' chains execute concurrently
+        on different chips.
     """
 
     def __init__(
@@ -256,12 +316,22 @@ class DispatchPlane:
         max_batch: int = 256,
         coalesce_wait_us: float = 2000.0,
         async_prep: bool = False,
+        mesh=None,
     ):
+        from jepsen_tpu.checker.sharded import resolve_mesh
+
         self.model = model
         self.interpret = interpret
         self.race = race
         self.max_batch = max_batch
         self.coalesce_wait_s = coalesce_wait_us / 1e6
+        self.mesh = resolve_mesh(mesh)
+        self._devices = (
+            list(self.mesh.devices.flat)
+            if self.mesh is not None
+            else jax.devices()[:1]
+        )
+        self._rr = itertools.count()
         self._lock = threading.Lock()  # inbox + buckets + launched
         self._pump_lock = threading.Lock()  # serializes prep/flush
         self._collect_lock = threading.Lock()  # serializes resolution
@@ -523,6 +593,23 @@ class DispatchPlane:
         for f in launch.futs:
             self._start_racer(f)
 
+    def _note_launch(self, n_requests: int, mesh=None) -> None:
+        """Per-device accounting for one dispatch. A mesh-sharded
+        stacked launch runs one shard on EVERY chip (1 launch each);
+        its real requests split by the key_spec block layout (device i
+        holds rows [i*k, (i+1)*k) of the padded batch). A solo/no-mesh
+        dispatch lands whole on one device."""
+        if mesh is None:
+            _bump_device(
+                str(self._devices[0]), requests=n_requests, launches=1
+            )
+            return
+        devs = list(mesh.devices.flat)
+        per = (n_requests + len(devs) - 1) // len(devs)
+        for i, d in enumerate(devs):
+            got = min(max(n_requests - i * per, 0), per)
+            _bump_device(str(d), requests=got, launches=1)
+
     def _flush_bucket(self, key) -> None:
         with self._lock:
             b = self._buckets.pop(key, None)
@@ -558,8 +645,9 @@ class DispatchPlane:
         })
         launch.handle = bs.launch_keys_bitset(
             [f.steps for f in futs], model=name, S=S,
-            interpret=interpret, exact=exact,
+            interpret=interpret, exact=exact, mesh=self.mesh,
         )
+        self._note_launch(len(futs), self.mesh)
         self._register_launch(launch)
 
     def _dispatch_vmap_batch(self, futs, key) -> None:
@@ -569,27 +657,67 @@ class DispatchPlane:
 
         _, name, W, _n, ladder = key
         K = ladder[0]
-        cols = stack_streams(
-            [f.events for f in futs], W=W, model=name
-        )
-        args = tuple(jnp.asarray(c) for c in cols)
         launch = _Launch("vmap", futs, {
             "model": name, "K": K, "W": W, "k_ladder": ladder,
+            "method": (
+                "tpu-wgl-sharded" if self.mesh is not None
+                else "tpu-wgl-batch"
+            ),
         })
-        launch.handle = _wgl_vmap(*args, model_name=name, K=K, W=W)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from jepsen_tpu.checker.sharded import (
+                key_spec,
+                make_sharded_checker,
+                mesh_size,
+                note_sharded_launch,
+            )
+
+            n_dev = mesh_size(self.mesh)
+            n_keys = ((len(futs) + n_dev - 1) // n_dev) * n_dev
+            cols = stack_streams(
+                [f.events for f in futs], W=W, n_keys=n_keys,
+                model=name,
+            )
+            sharding = NamedSharding(self.mesh, key_spec(self.mesh))
+            args = tuple(
+                jax.device_put(np.asarray(c), sharding) for c in cols
+            )
+            fn = make_sharded_checker(self.mesh, name, K, W)
+            launch.handle = fn(*args)
+            note_sharded_launch(n_dev)
+        else:
+            cols = stack_streams(
+                [f.events for f in futs], W=W, model=name
+            )
+            args = tuple(jnp.asarray(c) for c in cols)
+            launch.handle = _wgl_vmap(*args, model_name=name, K=K, W=W)
+        self._note_launch(len(futs), self.mesh)
         self._register_launch(launch)
 
     def _dispatch_segmented(self, fut: CheckFuture) -> None:
         _bump("solo_launches")
+        # Round-robin segmented chains across the mesh: independent
+        # requests' chains execute concurrently on different chips,
+        # each on its own per-device launch train (jit follows the
+        # committed args — see launch_steps_bitset_segmented).
+        dev = None
+        if self.mesh is not None:
+            dev = self._devices[next(self._rr) % len(self._devices)]
         launch = _Launch("segmented", [fut], {})
         try:
             launch.handle = bs.launch_steps_bitset_segmented(
                 fut.steps, model=fut.model, S=fut.S,
-                interpret=self.interpret,
+                interpret=self.interpret, device=dev,
             )
         except BaseException as e:  # noqa: BLE001
             fut._fail(e)
             return
+        _bump_device(
+            str(dev if dev is not None else self._devices[0]),
+            requests=1, launches=1,
+        )
         self._register_launch(launch)
 
     # -- collection ----------------------------------------------------
@@ -765,6 +893,7 @@ class DispatchPlane:
             model=launch.meta["model"],
             k_ladder=launch.meta["k_ladder"],
             K=launch.meta["K"],
+            method=launch.meta.get("method", "tpu-wgl-batch"),
         )
         for f, r in zip(live, results):
             self._finish(f, r)
@@ -794,14 +923,19 @@ class DispatchPlane:
         S: int = 8,
         interpret: bool = False,
         exact: bool = False,
+        mesh=None,
     ) -> List[tuple]:
         """The check_keys_bitset engine, routed through the plane's
         launch/collect machinery: the caller's pre-stacked batch
         dispatches as ONE launch (launch accounting unchanged — tests
-        pin launches==1), rides the shared launch train, and collects
-        with the train's single sync. Returns raw (alive, taint, died)
-        tuples."""
+        pin launches==1; a mesh-sharded batch is still one launch),
+        rides the shared launch train, and collects with the train's
+        single sync. Returns raw (alive, taint, died) tuples.
+
+        mesh: None defers to the plane's mesh; False forces the
+        single-device dispatch; a Mesh shards the batch explicitly."""
         name = model if isinstance(model, str) else model.name
+        use_mesh = self.mesh if mesh is None else (mesh or None)
         futs = []
         for st in steps_list:
             f = CheckFuture(self, None, name)
@@ -822,8 +956,9 @@ class DispatchPlane:
         })
         launch.handle = bs.launch_keys_bitset(
             steps_list, model=name, S=S, interpret=interpret,
-            exact=exact,
+            exact=exact, mesh=use_mesh,
         )
+        self._note_launch(len(futs), use_mesh)
         self._register_launch(launch)
         self._collect_upto(launch)
         return [f.result() for f in futs]

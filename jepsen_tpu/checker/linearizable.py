@@ -740,7 +740,7 @@ def split_queue_history_by_value(history):
 
 
 def check_queue_by_value(history, model: str, init_value=None,
-                         plane=None):
+                         plane=None, mesh=None):
     """Batched per-value queue check (split_queue_history_by_value),
     or None when the history doesn't decompose / a subhistory blows
     the window. Verdict merge: valid iff every value is; the first
@@ -750,7 +750,13 @@ def check_queue_by_value(history, model: str, init_value=None,
     plane: a dispatch.DispatchPlane — the per-value substreams submit
     as individual requests and coalesce with whatever else the plane
     holds (other keys, other checkers) instead of forming their own
-    private batch; verdict-identical to the check_keys path."""
+    private batch; verdict-identical to the check_keys path.
+
+    mesh: execution layout for the batched (non-plane) path, with
+    sharded.resolve_mesh semantics — None auto-shards over every
+    visible device when more than one is visible, False pins one
+    device, a Mesh is explicit. A plane carries its own mesh, so
+    mesh is ignored when plane is given."""
     subs = split_queue_history_by_value(history)
     if subs is None or not subs:
         return None
@@ -774,7 +780,9 @@ def check_queue_by_value(history, model: str, init_value=None,
     else:
         from jepsen_tpu.checker.sharded import check_keys
 
-        results = check_keys(list(streams.values()), model=model)
+        results = check_keys(
+            list(streams.values()), model=model, mesh=mesh
+        )
     methods: dict = {}
     for r in results:
         methods[r["method"]] = methods.get(r["method"], 0) + 1
@@ -818,6 +826,7 @@ class LinearizableChecker:
         init_value: Any = None,
         use_tpu: bool = True,
         plane=None,
+        mesh=None,
     ):
         self.model = model
         self.init_value = init_value
@@ -827,6 +836,12 @@ class LinearizableChecker:
         # instances) into shared device launches instead of paying the
         # sync floor each. Verdicts are identical either way.
         self.plane = plane
+        # Execution layout for batched non-plane paths (queue-by-value
+        # substreams), sharded.resolve_mesh semantics: None auto-shards
+        # over every visible device when >1 is visible, False pins one
+        # device, a Mesh is explicit. A configured plane already
+        # carries its own mesh and ignores this.
+        self.mesh = mesh
 
     def check_async(self, test, history, opts=None):
         """Submit this history to the configured dispatch plane and
@@ -874,7 +889,7 @@ class LinearizableChecker:
             # packed envelope real value domains immediately exceed.
             out = check_queue_by_value(
                 history, self.model, init_value=self.init_value,
-                plane=self.plane,
+                plane=self.plane, mesh=self.mesh,
             )
             if out is not None:
                 out["n_ops"] = len(history)

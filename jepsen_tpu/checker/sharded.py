@@ -18,6 +18,7 @@ multi-key workloads (zookeeper 10k x 16 keys in BASELINE.md).
 from __future__ import annotations
 
 import functools
+import threading
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -122,6 +123,103 @@ def key_spec(mesh: Mesh) -> P:
     return P(tuple(mesh.axis_names))
 
 
+#: mesh-path accounting: "sharded_launches" counts shard_map dispatches
+#: (bitset or vmap tier), "last_n_devices" the device count of the most
+#: recent one. dryrun_multichip and bench's one-device guard read these
+#: to prove the mesh path actually engaged — MULTICHIP_r03-r05 exited 0
+#: with an empty tail, so a silent fallback to one device must be loud.
+MESH_STATS = {"sharded_launches": 0, "last_n_devices": 0}
+
+_mesh_stats_lock = threading.Lock()
+
+
+def note_sharded_launch(n_devices: int) -> None:
+    with _mesh_stats_lock:
+        MESH_STATS["sharded_launches"] += 1
+        MESH_STATS["last_n_devices"] = int(n_devices)
+
+
+def reset_mesh_stats() -> None:
+    with _mesh_stats_lock:
+        MESH_STATS["sharded_launches"] = 0
+        MESH_STATS["last_n_devices"] = 0
+
+
+def mesh_size(mesh: Mesh) -> int:
+    """Device count of a mesh = product over every axis (keys shard
+    over the full product; see key_spec)."""
+    return int(np.prod([mesh.shape[ax] for ax in mesh.axis_names]))
+
+
+@functools.lru_cache(maxsize=None)
+def _mesh_over(devices: tuple) -> Mesh:
+    return Mesh(np.asarray(devices), axis_names=("keys",))
+
+
+def default_mesh() -> Optional[Mesh]:
+    """The ambient execution mesh: a 1-D Mesh over every visible device
+    when more than one is visible, else None. check_keys and the
+    dispatch plane consult this when the caller passes mesh=None, so
+    multi-chip hosts (and the tests' virtual 8-device CPU mesh) go
+    sharded by default while a single-device host keeps the exact
+    byte-identical single-device dispatch."""
+    devs = jax.devices()
+    if len(devs) < 2:
+        return None
+    return _mesh_over(tuple(devs))
+
+
+def resolve_mesh(mesh) -> Optional[Mesh]:
+    """The one mesh-selection rule: None -> auto (default_mesh),
+    False -> force the single-device path, a Mesh passes through."""
+    if mesh is None:
+        return default_mesh()
+    if mesh is False:
+        return None
+    return mesh
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_bitset(
+    mesh: Mesh, model_name: str, S: int, W: int,
+    interpret: bool, exact: bool,
+):
+    """Build (and cache) the shard_map wrapper around the stacked
+    bitset batch (wgl_bitset._bitset_scan): a coalesced bucket of B
+    keys runs B/n_devices per chip — one launch, one sync, all chips.
+    Keys are independent, so the per-shard scan is collective-free;
+    in/out specs both use key_spec, exactly like the vmap checker.
+    The MULTICHIP_r02 crash class (element_type_p.bind under
+    shard_map) is pinned by the tier-1 CPU-mesh differential."""
+    from jepsen_tpu.checker import wgl_bitset as bs
+
+    spec = key_spec(mesh)
+
+    def per_shard(win, meta, fr0):
+        return bs._bitset_scan(
+            win, meta, fr0, model_name=model_name, S=S, W=W,
+            interpret=interpret, exact=exact,
+        )
+
+    try:
+        sharded = _shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(spec,) * 3,
+            out_specs=(spec, spec),
+            check_vma=False,
+        )
+    except TypeError:  # pragma: no cover - older JAX
+        sharded = _shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(spec,) * 3,
+            out_specs=(spec, spec),
+            check_rep=False,
+        )
+    return jax.jit(sharded)
+
+
 @functools.lru_cache(maxsize=None)
 def make_sharded_checker(mesh: Mesh, model_name: str, K: int, W: int):
     """Build (and cache) a jit'd function checking stacked key columns
@@ -160,20 +258,25 @@ def make_sharded_checker(mesh: Mesh, model_name: str, K: int, W: int):
 def check_keys(
     streams: Sequence[EventStream],
     model: str = "cas-register",
-    mesh: Optional[Mesh] = None,
+    mesh=None,
     k_ladder=K_LADDER,
     interpret: bool = False,
 ) -> List[dict]:
     """Check many independent per-key event streams at once.
 
-    With a mesh, keys shard across devices (padded to a multiple of the
-    mesh size); without, the DEFAULT path is the exact bitset batch:
-    one kernel launch, one host sync for ALL keys (the
-    independent.clj:266-288 role on device — zookeeper-10kx16 pays the
-    tunnel floor once, not 16 times). Keys outside the bitset envelope
-    ride the megakernel batch / vmap ladder. Keys whose False verdict
-    is tainted by frontier overflow re-check individually through the
-    escalation ladder / oracle.
+    mesh selects the execution layout: ``None`` (the default) takes a
+    mesh over ALL visible devices whenever more than one is visible
+    (default_mesh), ``False`` forces the single-device path, and an
+    explicit ``jax.sharding.Mesh`` is used as given. With a mesh, keys
+    shard across devices (padded to a multiple of the mesh size) —
+    the bitset batch itself shard_maps (make_sharded_bitset), so the
+    default path stays the exact bitset batch: one kernel launch, one
+    host sync for ALL keys on ALL chips (the independent.clj:266-288
+    role on device — zookeeper-10kx16 pays the tunnel floor once, not
+    16 times, and B/n_devices keys scan per chip). Keys outside the
+    bitset envelope ride the megakernel batch / sharded-vmap ladder.
+    Keys whose False verdict is tainted by frontier overflow re-check
+    individually through the escalation ladder / oracle.
 
     interpret runs the bitset batch in Pallas interpret mode on CPU —
     the tests' seam for pinning the one-launch contract without a TPU.
@@ -181,6 +284,7 @@ def check_keys(
     n_real = len(streams)
     if n_real == 0:
         return []
+    mesh = resolve_mesh(mesh)
     from jepsen_tpu.checker.models import model as get_model
 
     m = get_model(model)
@@ -204,7 +308,12 @@ def check_keys(
             bad_idx = [i for i, e in enumerate(in_env) if not e]
             kernel_res = check_keys(
                 [streams[i] for i in ok_idx],
-                model=m.packed_variant, mesh=mesh, k_ladder=k_ladder,
+                model=m.packed_variant,
+                # mesh is resolved: pass False (not None) when it
+                # resolved to single-device, or auto-detection would
+                # re-engage in the recursion.
+                mesh=mesh if mesh is not None else False,
+                k_ladder=k_ladder,
                 interpret=interpret,
             )
             verdicts, meta = check_streams(
@@ -239,48 +348,52 @@ def check_keys(
             for v, rung in zip(verdicts, meta["rungs"])
         ]
     if mesh is not None:
-        n_dev = int(np.prod([mesh.shape[ax] for ax in mesh.axis_names]))
+        n_dev = mesh_size(mesh)
         n_keys = ((n_real + n_dev - 1) // n_dev) * n_dev
     else:
         n_keys = n_real
     K = k_ladder[0]
 
-    if mesh is None:
-        from jepsen_tpu.checker.linearizable import _on_tpu, _pallas_ok
-        from jepsen_tpu.checker.events import n_words
+    from jepsen_tpu.checker.linearizable import _on_tpu, _pallas_ok
+    from jepsen_tpu.checker.events import n_words
 
-        if _on_tpu() or interpret:
-            # Exact bitset batch first (one launch, one sync, definite
-            # verdicts — no per-key escalation): all keys must fit its
-            # envelope, sharing the max window/state buckets.
-            from jepsen_tpu.checker import wgl_bitset as bs
-            from jepsen_tpu.checker.models import model as get_model
+    if _on_tpu() or interpret:
+        # Exact bitset batch first (one launch, one sync, definite
+        # verdicts — no per-key escalation): all keys must fit its
+        # envelope, sharing the max window/state buckets. With a mesh
+        # the stacked batch itself shard_maps across devices inside
+        # launch_keys_bitset — same method string, same one-launch
+        # contract, B/n_devices keys per chip.
+        from jepsen_tpu.checker import wgl_bitset as bs
+        from jepsen_tpu.checker.models import model as get_model
 
-            bplan = bs.plan(
-                get_model(model),
-                window,
-                max(len(s.value_codes) for s in streams),
+        bplan = bs.plan(
+            get_model(model),
+            window,
+            max(len(s.value_codes) for s in streams),
+        )
+        if bplan is not None:
+            bW, S = bplan
+            steps = [events_to_steps(s, W=bW) for s in streams]
+            outs = bs.check_keys_bitset(
+                steps, model=model, S=S, interpret=interpret,
+                mesh=mesh if mesh is not None else False,
             )
-            if bplan is not None:
-                bW, S = bplan
-                steps = [events_to_steps(s, W=bW) for s in streams]
-                outs = bs.check_keys_bitset(
-                    steps, model=model, S=S, interpret=interpret
-                )
-                if not any(o[1] for o in outs):  # no taint ever
-                    res: List[dict] = []
-                    for o in outs:
-                        r = {
-                            "valid?": bool(o[0]),
-                            "method": "tpu-wgl-bitset-batch",
-                            "frontier_k": None,
-                            "escalations": 0,
-                        }
-                        if not o[0]:
-                            r["failed_op_index"] = int(o[2])
-                        res.append(r)
-                    return res
+            if not any(o[1] for o in outs):  # no taint ever
+                res: List[dict] = []
+                for o in outs:
+                    r = {
+                        "valid?": bool(o[0]),
+                        "method": "tpu-wgl-bitset-batch",
+                        "frontier_k": None,
+                        "escalations": 0,
+                    }
+                    if not o[0]:
+                        r["failed_op_index"] = int(o[2])
+                    res.append(r)
+                return res
 
+    if mesh is None:
         if _on_tpu() and _pallas_ok(K, W, n_words(W)):
             # One batched megakernel launch: keys form the outer grid
             # dimension, one host sync for the whole batch.
@@ -353,6 +466,7 @@ def check_keys(
         args = tuple(jax.device_put(np.asarray(c), sharding) for c in cols)
         fn = make_sharded_checker(mesh, model, K, W)
         alive, overflow, died = fn(*args)
+        note_sharded_launch(n_dev)
     alive = np.asarray(alive)[:n_real]
     overflow = np.asarray(overflow)[:n_real]
     died = np.asarray(died)[:n_real]

@@ -784,6 +784,7 @@ def launch_steps_bitset_segmented(
     interpret: bool = False,
     exact: bool = False,
     min_len: int | None = None,
+    device=None,
 ):
     """Dispatch the multi-segment scan WITHOUT the final host fetch:
     the ENTIRE plan runs as one jitted computation (_chain_scan) — one
@@ -793,13 +794,23 @@ def launch_steps_bitset_segmented(
     one). The returned handle carries each segment's device verdict +
     death frontier + input frontier for a later collect. By default
     segments run on the FAST fixed-round kernel; the collect escalates
-    a death to the exact kernel."""
+    a death to the exact kernel.
+
+    device: commit the packed args to a specific chip before the
+    dispatch — jit follows committed data, so the dispatch plane's
+    round-robin places independent chains on different devices and
+    they execute concurrently (one compiled executable caches per
+    placement). None keeps the default-device behavior byte-identical.
+    """
     segs = _plan_for(steps, min_len)
     name = model if isinstance(model, str) else model.name
     args = _segment_args(steps, segs)
     fr0 = jnp.asarray(
         init_frontier(steps.init_state, S, segs[0][2])[None]
     )
+    if device is not None:
+        args = tuple(jax.device_put(a, device) for a in args)
+        fr0 = jax.device_put(fr0, device)
     seg_ws = tuple(W for _, _, W in segs)
     _bump_launch("launches")
     outs, frs, fr_ins = _chain_scan(
@@ -977,6 +988,7 @@ def launch_keys_bitset(
     S: int = 8,
     interpret: bool = False,
     exact: bool = False,
+    mesh=None,
 ):
     """Dispatch the batched per-key scan WITHOUT a host sync: returns
     a handle with the device verdict array. Collecting later
@@ -984,7 +996,15 @@ def launch_keys_bitset(
     device work behind one another — the tunnel's round-trip floor is
     paid once per pipeline, not once per batch. Keys run on the fast
     fixed-round kernel by default; the collect re-checks any key the
-    fast tier reported dead on the exact kernel (see _make_kernel)."""
+    fast tier reported dead on the exact kernel (see _make_kernel).
+
+    mesh (a jax.sharding.Mesh of >1 device): the key axis pads to a
+    multiple of the mesh size with blank rows (no live steps —
+    trivially alive, sliced off at collect) and the batch dispatches
+    through the shard_map wrapper (sharded.make_sharded_bitset):
+    B keys run B/n_devices per chip, still ONE launch and one sync.
+    mesh=None (or a 1-device mesh) keeps the single-device dispatch
+    byte-identical."""
     n = bucket(max(max(len(st) for st in steps_list), 1), 64)
     name = model if isinstance(model, str) else model.name
     W = steps_list[0].W
@@ -997,35 +1017,83 @@ def launch_keys_bitset(
         )
         wins.append(w)
         metas.append(m)
-    fr0 = jnp.asarray(np.stack([
+    n_real = len(steps_list)
+    win_h = np.stack(wins)
+    meta_h = np.stack(metas)
+    fr0_h = np.stack([
         init_frontier(st.init_state, S, W) for st in steps_list
-    ]))
-    win_j = jnp.asarray(np.stack(wins))
-    meta_j = jnp.asarray(np.stack(metas))
-    _bump_launch("launches")
-    out, _ = _bitset_scan(
-        win_j, meta_j, fr0,
-        model_name=name,
-        S=S,
-        W=W,
-        interpret=interpret,
-        exact=exact,
+    ])
+    n_dev = 0
+    if mesh is not None:
+        from jepsen_tpu.checker.sharded import mesh_size
+
+        n_dev = mesh_size(mesh)
+    if n_dev > 1:
+        from jax.sharding import NamedSharding
+
+        from jepsen_tpu.checker.sharded import (
+            key_spec,
+            make_sharded_bitset,
+            note_sharded_launch,
+        )
+
+        pad = -n_real % n_dev
+        if pad:
+            win_h = np.concatenate([
+                win_h,
+                np.zeros((pad,) + win_h.shape[1:], win_h.dtype),
+            ])
+            meta_h = np.concatenate([
+                meta_h,
+                np.zeros((pad,) + meta_h.shape[1:], meta_h.dtype),
+            ])
+            fr0_h = np.concatenate([
+                fr0_h,
+                np.repeat(init_frontier(0, S, W)[None], pad, axis=0),
+            ])
+        sharding = NamedSharding(mesh, key_spec(mesh))
+        win_j = jax.device_put(win_h, sharding)
+        meta_j = jax.device_put(meta_h, sharding)
+        fr0 = jax.device_put(fr0_h, sharding)
+        fn = make_sharded_bitset(mesh, name, S, W, interpret, exact)
+        _bump_launch("launches")
+        note_sharded_launch(n_dev)
+        out, _ = fn(win_j, meta_j, fr0)
+    else:
+        mesh = None  # a 1-device mesh IS the single-device path
+        win_j = jnp.asarray(win_h)
+        meta_j = jnp.asarray(meta_h)
+        fr0 = jnp.asarray(fr0_h)
+        _bump_launch("launches")
+        out, _ = _bitset_scan(
+            win_j, meta_j, fr0,
+            model_name=name,
+            S=S,
+            W=W,
+            interpret=interpret,
+            exact=exact,
+        )
+    return out, (
+        win_j, meta_j, fr0, name, S, W, interpret, exact, mesh, n_real
     )
-    return out, (win_j, meta_j, fr0, name, S, W, interpret, exact)
 
 
 def collect_keys_bitset(handle, out_host=None) -> List[Tuple[bool, bool, int]]:
     """Block on a launch_keys_bitset handle and decode verdicts,
     re-running the whole batch on the exact kernel if any key's fast
-    verdict was a (provisional) death.
+    verdict was a (provisional) death. A sharded launch escalates
+    sharded too (its device args are already mesh-resident); padding
+    rows are sliced off before the verdicts return.
 
     out_host: pre-fetched host copy of the handle's out array (the
     dispatch plane's one-sync-per-train collect); the escalation
     re-run, when needed, still syncs on its own."""
-    out, (win_j, meta_j, fr0, name, S, W, interpret, exact) = handle
+    out, (
+        win_j, meta_j, fr0, name, S, W, interpret, exact, mesh, n_real
+    ) = handle
     verdicts = _out_to_verdicts(
         np.asarray(out if out_host is None else out_host)
-    )
+    )[:n_real]
     if exact or all(v[0] for v in verdicts):
         return verdicts
     # A fast-tier death is provisional: the exact kernel decides. The
@@ -1033,11 +1101,22 @@ def collect_keys_bitset(handle, out_host=None) -> List[Tuple[bool, bool, int]]:
     # resident; dead keys are rare, so this is the uncommon path).
     _bump_launch("launches")
     _bump_launch("escalations")
-    out2, _ = _bitset_scan(
-        win_j, meta_j, fr0,
-        model_name=name, S=S, W=W, interpret=interpret, exact=True,
-    )
-    return _out_to_verdicts(np.asarray(out2))
+    if mesh is not None:
+        from jepsen_tpu.checker.sharded import (
+            make_sharded_bitset,
+            mesh_size,
+            note_sharded_launch,
+        )
+
+        fn = make_sharded_bitset(mesh, name, S, W, interpret, True)
+        note_sharded_launch(mesh_size(mesh))
+        out2, _ = fn(win_j, meta_j, fr0)
+    else:
+        out2, _ = _bitset_scan(
+            win_j, meta_j, fr0,
+            model_name=name, S=S, W=W, interpret=interpret, exact=True,
+        )
+    return _out_to_verdicts(np.asarray(out2))[:n_real]
 
 
 def check_keys_bitset(
@@ -1046,6 +1125,7 @@ def check_keys_bitset(
     S: int = 8,
     interpret: bool = False,
     exact: bool = False,
+    mesh=None,
 ) -> List[Tuple[bool, bool, int]]:
     """Batch of per-key checks in ONE kernel launch + host sync (two
     launches when a fast-tier death escalates to the exact kernel).
@@ -1056,9 +1136,14 @@ def check_keys_bitset(
     the batch is still exactly one launch (the launch-count contracts
     above hold unchanged), but it joins the plane's launch train and
     stats surface, so concurrent callers pipeline behind one another
-    and collect with a shared sync."""
+    and collect with a shared sync.
+
+    mesh: None lets the plane decide (its own mesh — all visible
+    devices when >1), False forces the single-device dispatch, a Mesh
+    shards the batch explicitly."""
     from jepsen_tpu.checker.dispatch import default_plane
 
     return default_plane().run_keys(
-        steps_list, model=model, S=S, interpret=interpret, exact=exact
+        steps_list, model=model, S=S, interpret=interpret, exact=exact,
+        mesh=mesh,
     )

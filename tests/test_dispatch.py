@@ -382,7 +382,9 @@ def test_check_async_invalid_carries_failure_report(tmp_path):
             {}, h, opts={"subdirectory": str(tmp_path)}
         )
         out = resolve()
-    assert out["method"] == "tpu-wgl-batch"  # really the vmap tier
+    # Really the vmap tier: "tpu-wgl-sharded" when the plane sees a
+    # multi-device mesh (tier-1 pins 8 host devices), plain batch solo.
+    assert out["method"] in ("tpu-wgl-batch", "tpu-wgl-sharded")
     assert out["valid?"] is False
     assert "failure" in out
     assert out["failed_op_index"] == seq["failed_op_index"]

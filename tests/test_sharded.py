@@ -62,7 +62,9 @@ def test_sharded_2d_mesh_matches_oracle():
         assert r["valid?"] == oracle_check(s)
 
 
-def test_graft_entry_contract():
+def test_graft_entry_contract(capfd):
+    import json
+
     import __graft_entry__ as g
 
     fn, args = g.entry()
@@ -70,6 +72,20 @@ def test_graft_entry_contract():
     assert bool(alive) is True
     assert int(died) == -1
     g.dryrun_multichip(8)
+    # The multichip dryrun must publish exactly one parsable JSON
+    # metric line on stdout (the driver's MULTICHIP tail was empty in
+    # r03-r05). It runs in a subprocess, so capture at the fd level.
+    tail = [
+        ln for ln in capfd.readouterr()[0].strip().splitlines() if ln
+    ]
+    assert tail, "dryrun_multichip printed nothing"
+    rec = json.loads(tail[-1])
+    assert rec["metric"] == "sharded_keys_per_sec"
+    assert rec["n_devices"] == 8
+    assert rec["n_devices_used"] == 8
+    assert rec["value"] > 0
+    assert rec["scaling_efficiency"] >= 0.6
+    assert rec["mesh_wall_s"] > 0 and rec["single_wall_s"] > 0
 
 
 def test_sharded_at_scale_with_escalation_keys():
@@ -107,7 +123,8 @@ def test_sharded_at_scale_with_escalation_keys():
 
 
 def test_batch_path_escalation_on_one_device():
-    # Same shape through the single-device batched path (no mesh).
+    # Same shape through the single-device batched path: mesh=False
+    # pins one device even when tier-1 exposes 8 host devices.
     streams = []
     for seed in range(24):
         rng = random.Random(9500 + seed)
@@ -118,7 +135,7 @@ def test_batch_path_escalation_on_one_device():
         if seed % 3 == 0:
             h = corrupt_history(h, rng)
         streams.append(history_to_events(h))
-    results = check_keys(streams, k_ladder=(2, 128))
+    results = check_keys(streams, k_ladder=(2, 128), mesh=False)
     for i, (s, r) in enumerate(zip(streams, results)):
         assert r["valid?"] == oracle_check(s), f"key {i}: {r}"
 
