@@ -571,6 +571,76 @@ def bench_host_prep():
     return out
 
 
+# -- chaos smoke (--chaos) ---------------------------------------------------
+
+
+def bench_chaos_smoke() -> None:
+    """--chaos: resilience flow validation, not a measurement. Each
+    register config runs twice through a fresh DispatchPlane — once
+    clean, once with ONE transient launch fault injected via the plane
+    nemesis — and the verdicts must match field-for-field (wall time
+    excluded) with the retry visible in dispatch_stats()["resilience"].
+    Prints one JSON line so the driver can gate on it."""
+    from jepsen_tpu.checker import chaos
+    from jepsen_tpu.checker.dispatch import (
+        DispatchPlane, dispatch_stats, reset_dispatch_stats,
+    )
+    from jepsen_tpu.checker.events import clear_memos
+    from jepsen_tpu.checker.linearizable import _on_tpu
+
+    interp = not _on_tpu()
+    configs = {
+        "etcd-1k": _etcd_streams(),
+        "zookeeper-10kx16": _zk_streams(),
+    }
+
+    def run_plane(streams):
+        for s in streams:
+            clear_memos(s)
+        with DispatchPlane(interpret=interp, async_prep=False) as plane:
+            futs = [plane.submit(s) for s in streams]
+            plane.flush()
+            return [f.result() for f in futs]
+
+    def strip(out):
+        return {k: v for k, v in out.items() if k != "wall_s"}
+
+    report = {}
+    for name, streams in configs.items():
+        clean = run_plane(streams)
+        chaos.reset_resilience()
+        reset_dispatch_stats()
+        with chaos.chaos_plan(
+            chaos.transient_fault(site="launch", times=1)
+        ):
+            faulted = run_plane(streams)
+        res = dispatch_stats()["resilience"]
+        assert [strip(o) for o in clean] == [strip(o) for o in faulted], (
+            f"{name}: verdicts diverged under a transient fault"
+        )
+        assert res["faults_injected"] >= 1 and res["retries"] >= 1, (
+            f"{name}: fault never injected or never retried: {res}"
+        )
+        print(
+            f"chaos smoke {name}: {len(streams)} streams, "
+            f"retries={res['retries']} "
+            f"faults_injected={res['faults_injected']} — verdict parity "
+            "holds",
+            file=sys.stderr,
+        )
+        report[name] = {
+            "n_streams": len(streams),
+            "retries": res["retries"],
+            "faults_injected": res["faults_injected"],
+        }
+    print(json.dumps({
+        "metric": "chaos_smoke_parity",
+        "value": 1,
+        "unit": "bool",
+        "configs": report,
+    }))
+
+
 # -- reduction configs (3, 4, 5) ---------------------------------------------
 
 
@@ -854,6 +924,14 @@ def main() -> None:
         SMOKE = True
         print("SMOKE MODE: flow validation, not a measurement",
               file=sys.stderr)
+    chaos_mode = "--chaos" in sys.argv
+    if chaos_mode and not SMOKE:
+        SMOKE = True
+        print(
+            "CHAOS SMOKE MODE: fault-injection flow validation, not a "
+            "measurement",
+            file=sys.stderr,
+        )
     # Gate BEFORE importing jax: plugin registration itself can touch
     # the wedged tunnel and hang the parent uninterruptibly — smoke
     # runs included (the probe is seconds on a healthy host).
@@ -881,6 +959,10 @@ def main() -> None:
     _pin = os.environ.get("JAX_PLATFORMS")
     if _pin:
         jax.config.update("jax_platforms", _pin)
+
+    if chaos_mode:
+        bench_chaos_smoke()
+        return
 
     if "--profile" in sys.argv:
         # Device-trace the register plane (utils/profiling.trace):

@@ -86,7 +86,9 @@ from typing import Any, List, Optional
 import jax
 import numpy as np
 
+from jepsen_tpu.checker import chaos
 from jepsen_tpu.checker import wgl_bitset as bs
+from jepsen_tpu.checker.chaos import PlaneFault
 from jepsen_tpu.checker.events import (
     EventStream,
     bucket,
@@ -115,7 +117,11 @@ from jepsen_tpu.checker.models import model as get_model
 #: "max_batch" = largest batch occupancy seen,
 #: "coalesce_wait_us" = total microseconds batched requests spent
 #: parked in a bucket waiting for partners (the latency cost of
-#: coalescing), "native_wins" = racer verdicts that beat the device.
+#: coalescing), "native_wins" = racer verdicts that beat the device,
+#: "worker_errors" = exceptions the async prep worker's keep-alive
+#: swallowed (soaks assert zero), "pending_at_close" = futures still
+#: unresolved when close() returned (resolved with a PlaneFault, never
+#: dropped — nonzero means a leaked worker or an abandoned train).
 DISPATCH_STATS = {
     "requests": 0,
     "batches": 0,
@@ -125,9 +131,15 @@ DISPATCH_STATS = {
     "max_batch": 0,
     "coalesce_wait_us": 0.0,
     "native_wins": 0,
+    "worker_errors": 0,
+    "pending_at_close": 0,
 }
 
 _stats_lock = threading.Lock()
+
+#: "no explicit mesh" sentinel for _dispatch_resilient (None is a
+#: meaningful value: the single-device placement)
+_UNSET = object()
 
 #: per-device dispatch accounting (the mesh execution plane's view):
 #: device label -> {"launches": dispatches that placed work on this
@@ -201,6 +213,9 @@ def dispatch_stats() -> dict:
     out["per_device"] = per_dev
     out["n_devices"] = len(per_dev)
     out["launch"] = dict(bs.LAUNCH_STATS)
+    res = chaos.resilience_snapshot()
+    res["worker_errors"] = out["worker_errors"]
+    out["resilience"] = res
     return out
 
 
@@ -306,6 +321,21 @@ class DispatchPlane:
         segmented chain-scans round-robin onto per-device launch
         trains so independent requests' chains execute concurrently
         on different chips.
+      retry: chaos.RetryPolicy for the launch/collect guards (bounded
+        exponential backoff on transient/deadline fault classes);
+        None = chaos.DEFAULT_RETRY.
+      launch_deadline_s: per-guarded-call wall budget. A hung device
+        sync (the collect train's device_get, or a wedged launch)
+        times out with DeadlineExceeded instead of wedging the plane:
+        the call retries, then degrades — the worker stays alive and
+        the future always resolves. None = no deadline (the default:
+        first-compile stalls on real hardware can dwarf any static
+        budget, so deadlines are opt-in).
+      quarantine_after: attributed failures before a device is ejected
+        and launches re-shard onto the survivors.
+      worker_join_s: how long close() waits for the async prep worker
+        before declaring it leaked and resolving pending futures with
+        a PlaneFault.
     """
 
     def __init__(
@@ -317,6 +347,10 @@ class DispatchPlane:
         coalesce_wait_us: float = 2000.0,
         async_prep: bool = False,
         mesh=None,
+        retry: Optional[chaos.RetryPolicy] = None,
+        launch_deadline_s: Optional[float] = None,
+        quarantine_after: int = 3,
+        worker_join_s: float = 10.0,
     ):
         from jepsen_tpu.checker.sharded import resolve_mesh
 
@@ -325,6 +359,10 @@ class DispatchPlane:
         self.race = race
         self.max_batch = max_batch
         self.coalesce_wait_s = coalesce_wait_us / 1e6
+        self.retry = retry or chaos.DEFAULT_RETRY
+        self.launch_deadline_s = launch_deadline_s
+        self.quarantine_after = quarantine_after
+        self.worker_join_s = worker_join_s
         self.mesh = resolve_mesh(mesh)
         self._devices = (
             list(self.mesh.devices.flat)
@@ -412,12 +450,71 @@ class DispatchPlane:
         self._resolve_fallbacks()
 
     def close(self) -> None:
+        """Shut the plane down with every future accounted for: join
+        the prep worker (bounded), drain the train, and resolve ANY
+        still-pending future with a structured PlaneFault — close()
+        always returns, and no rider is ever silently dropped. A
+        worker that outlives its join budget is a leak: it may hold
+        _pump_lock, so the drain is skipped (it could wedge behind the
+        leak) and pending futures fail over immediately."""
         self._closing.set()
         self._wake.set()
+        leaked = None
         if self._worker is not None:
-            self._worker.join(timeout=10.0)
+            w = self._worker
+            w.join(timeout=self.worker_join_s)
+            if w.is_alive():
+                leaked = w
             self._worker = None
-        self.drain()
+        if leaked is not None:
+            import logging
+
+            logging.getLogger("jepsen_tpu.checker").error(
+                "dispatch plane prep worker %r failed to join within "
+                "%.1fs (leaked thread); resolving pending futures with "
+                "PlaneFault", leaked.name, self.worker_join_s,
+            )
+            self._fail_pending(PlaneFault(
+                site="close", kind="worker-leak", attempts=0,
+            ))
+            return
+        try:
+            self.drain()
+        finally:
+            self._fail_pending(PlaneFault(
+                site="close", kind="abandoned", attempts=0,
+            ))
+
+    def _fail_pending(self, pf: PlaneFault) -> int:
+        """Resolve every future the plane still holds with ``pf`` and
+        report the count (DISPATCH_STATS['pending_at_close']). Zero on
+        a clean close — drain() resolved the world."""
+        with self._lock:
+            futs = list(self._inbox)
+            self._inbox.clear()
+            for b in self._buckets.values():
+                futs.extend(b.futs)
+            self._buckets.clear()
+            futs.extend(self._fallbacks)
+            self._fallbacks = []
+            for L in self._launched:
+                futs.extend(L.futs)
+            self._launched = []
+        n = 0
+        for f in futs:
+            if not f.done():
+                f._fail(pf)
+                n += 1
+        if n:
+            import logging
+
+            _bump("pending_at_close", n)
+            chaos.note_plane_fault(n)
+            logging.getLogger("jepsen_tpu.checker").warning(
+                "dispatch plane closed with %d pending future(s); "
+                "resolved with %s", n, pf,
+            )
+        return n
 
     def __enter__(self) -> "DispatchPlane":
         return self
@@ -433,11 +530,14 @@ class DispatchPlane:
             self._wake.clear()
             try:
                 self._pump()
-            except Exception:  # pragma: no cover - keep the loop alive
+            except Exception:  # keep the loop alive, but never silently
                 import logging
 
+                _bump("worker_errors")
                 logging.getLogger("jepsen_tpu.checker").exception(
-                    "dispatch plane prep worker error"
+                    "dispatch plane prep worker error "
+                    "(DISPATCH_STATS['worker_errors'] counts these; "
+                    "soaks assert zero)"
                 )
 
     def _pump(self, flush_all: bool = False, flush_futs=()) -> None:
@@ -610,6 +710,122 @@ class DispatchPlane:
             got = min(max(n_requests - i * per, 0), per)
             _bump_device(str(d), requests=got, launches=1)
 
+    # -- resilience: guards + the degradation ladder -------------------
+
+    def _labels(self, mesh) -> List[str]:
+        """Device labels a guarded call may place work on — the chaos
+        seam's match set and the classifier's attribution domain."""
+        if mesh is not None:
+            return [str(d) for d in mesh.devices.flat]
+        return [str(d) for d in jax.devices()[:1]]
+
+    def _guard(self, site: str, thunk, devices) -> Any:
+        """Run one launch/collect callable through the chaos seam with
+        this plane's retry policy and per-call deadline. Raises a
+        structured PlaneFault when the budget is spent."""
+        return chaos.resilient_call(
+            thunk, site=site, devices=devices, policy=self.retry,
+            deadline_s=self.launch_deadline_s, on_fault=self._on_fault,
+        )
+
+    def _on_fault(self, kind: str, device: Optional[str],
+                  exc: BaseException) -> None:
+        """Per-attempt failure accounting: attributed failures count
+        against their device; crossing quarantine_after ejects it (the
+        ladder then re-shards onto the survivors)."""
+        if device is None:
+            return
+        if chaos.note_device_failure(device, self.quarantine_after):
+            from jepsen_tpu.checker.sharded import note_quarantine
+
+            import logging
+
+            note_quarantine(device)
+            logging.getLogger("jepsen_tpu.checker").warning(
+                "device %s quarantined after %d attributed failures "
+                "(%s: %s); launches re-shard onto the survivors",
+                device, self.quarantine_after, type(exc).__name__, exc,
+            )
+
+    def _after_fault(self, mesh):
+        """One degradation-ladder step after a guarded dispatch spent
+        its retry budget: (1) a quarantine ejection re-shards the mesh
+        onto the survivors (the blank-row pad absorbs the new uneven
+        split); (2) no survivors worth sharding — or no quarantine
+        evidence at all — drops to the single-device dispatch; (3) a
+        single-device failure exhausts the device rungs (the caller
+        falls back to the host oracle). Returns (next_mesh, exhausted).
+        Quarantine-driven shrinks of the PLANE's own mesh are sticky —
+        future dispatches skip the dead chip without re-failing."""
+        if mesh is None:
+            chaos.note_degradation()
+            return None, True
+        from jepsen_tpu.checker.sharded import mesh_without, note_reshard
+
+        healthy = mesh_without(mesh, chaos.quarantined_devices())
+        if healthy is not mesh and healthy is not None:
+            note_reshard()
+            if mesh is self.mesh:
+                self.mesh = healthy
+                self._devices = list(healthy.devices.flat)
+            return healthy, False
+        chaos.note_degradation()
+        if healthy is None and mesh is self.mesh:
+            # quarantine left <2 survivors: the plane goes single-device
+            self.mesh = None
+            self._devices = jax.devices()[:1]
+        return None, False
+
+    def _dispatch_resilient(self, launch_with, mesh=_UNSET):
+        """Drive ``launch_with(mesh)`` down the degradation ladder:
+        full mesh -> quarantine-resharded mesh -> single device.
+        Returns (handle, mesh_used, None) on success or
+        (None, None, PlaneFault) when every device rung failed — the
+        caller resolves the riders from the host oracle."""
+        mesh = self.mesh if mesh is _UNSET else mesh
+        while True:
+            try:
+                handle = self._guard(
+                    "launch", lambda: launch_with(mesh),
+                    self._labels(mesh),
+                )
+                return handle, mesh, None
+            except PlaneFault as pf:
+                mesh, exhausted = self._after_fault(mesh)
+                if exhausted:
+                    return None, None, pf
+
+    def _oracle_resolve(self, futs, pf: PlaneFault) -> None:
+        """The ladder's last rung: resolve each rider from the host
+        oracle (_oracle_decide — pure host, no device dispatch), whose
+        verdict is identical to the kernel path's by construction.
+        Raw steps-level futures (run_keys) carry no events to
+        re-decide, so they resolve with the structured PlaneFault
+        itself — the raw device exception never crosses result()."""
+        from jepsen_tpu.checker.linearizable import (
+            _oracle_decide,
+            _oracle_verdict,
+        )
+
+        for f in futs:
+            if f.done():
+                continue
+            if f.events is None:
+                chaos.note_plane_fault()
+                f._fail(pf)
+                continue
+            chaos.note_oracle_fallback()
+            try:
+                out = _oracle_verdict(*_oracle_decide(f.events, f.model))
+            except Exception as e:  # noqa: BLE001 - structured envelope
+                chaos.note_plane_fault()
+                f._fail(PlaneFault(
+                    site="oracle", kind="fatal", attempts=1, cause=e,
+                ))
+                continue
+            out["degraded"] = pf.describe()
+            self._finish(f, out)
+
     def _flush_bucket(self, key) -> None:
         with self._lock:
             b = self._buckets.pop(key, None)
@@ -639,15 +855,23 @@ class DispatchPlane:
 
     def _dispatch_bitset_batch(self, futs, key) -> None:
         _, name, S, _W, _n, interpret, exact = key
+
+        def launch_with(mesh):
+            return bs.launch_keys_bitset(
+                [f.steps for f in futs], model=name, S=S,
+                interpret=interpret, exact=exact, mesh=mesh,
+            )
+
+        handle, mesh_used, pf = self._dispatch_resilient(launch_with)
+        if handle is None:
+            self._oracle_resolve(futs, pf)
+            return
         launch = _Launch("bitset", futs, {
             "model": name, "S": S, "interpret": interpret,
             "exact": exact,
         })
-        launch.handle = bs.launch_keys_bitset(
-            [f.steps for f in futs], model=name, S=S,
-            interpret=interpret, exact=exact, mesh=self.mesh,
-        )
-        self._note_launch(len(futs), self.mesh)
+        launch.handle = handle
+        self._note_launch(len(futs), mesh_used)
         self._register_launch(launch)
 
     def _dispatch_vmap_batch(self, futs, key) -> None:
@@ -657,43 +881,52 @@ class DispatchPlane:
 
         _, name, W, _n, ladder = key
         K = ladder[0]
-        launch = _Launch("vmap", futs, {
-            "model": name, "K": K, "W": W, "k_ladder": ladder,
-            "method": (
-                "tpu-wgl-sharded" if self.mesh is not None
-                else "tpu-wgl-batch"
-            ),
-        })
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding
 
-            from jepsen_tpu.checker.sharded import (
-                key_spec,
-                make_sharded_checker,
-                mesh_size,
-                note_sharded_launch,
-            )
+        def launch_with(mesh):
+            if mesh is not None:
+                from jax.sharding import NamedSharding
 
-            n_dev = mesh_size(self.mesh)
-            n_keys = ((len(futs) + n_dev - 1) // n_dev) * n_dev
-            cols = stack_streams(
-                [f.events for f in futs], W=W, n_keys=n_keys,
-                model=name,
-            )
-            sharding = NamedSharding(self.mesh, key_spec(self.mesh))
-            args = tuple(
-                jax.device_put(np.asarray(c), sharding) for c in cols
-            )
-            fn = make_sharded_checker(self.mesh, name, K, W)
-            launch.handle = fn(*args)
-            note_sharded_launch(n_dev)
-        else:
+                from jepsen_tpu.checker.sharded import (
+                    key_spec,
+                    make_sharded_checker,
+                    mesh_size,
+                    note_sharded_launch,
+                )
+
+                n_dev = mesh_size(mesh)
+                n_keys = ((len(futs) + n_dev - 1) // n_dev) * n_dev
+                cols = stack_streams(
+                    [f.events for f in futs], W=W, n_keys=n_keys,
+                    model=name,
+                )
+                sharding = NamedSharding(mesh, key_spec(mesh))
+                args = tuple(
+                    jax.device_put(np.asarray(c), sharding)
+                    for c in cols
+                )
+                fn = make_sharded_checker(mesh, name, K, W)
+                out = fn(*args)
+                note_sharded_launch(n_dev)
+                return out
             cols = stack_streams(
                 [f.events for f in futs], W=W, model=name
             )
             args = tuple(jnp.asarray(c) for c in cols)
-            launch.handle = _wgl_vmap(*args, model_name=name, K=K, W=W)
-        self._note_launch(len(futs), self.mesh)
+            return _wgl_vmap(*args, model_name=name, K=K, W=W)
+
+        handle, mesh_used, pf = self._dispatch_resilient(launch_with)
+        if handle is None:
+            self._oracle_resolve(futs, pf)
+            return
+        launch = _Launch("vmap", futs, {
+            "model": name, "K": K, "W": W, "k_ladder": ladder,
+            "method": (
+                "tpu-wgl-sharded" if mesh_used is not None
+                else "tpu-wgl-batch"
+            ),
+        })
+        launch.handle = handle
+        self._note_launch(len(futs), mesh_used)
         self._register_launch(launch)
 
     def _dispatch_segmented(self, fut: CheckFuture) -> None:
@@ -701,19 +934,37 @@ class DispatchPlane:
         # Round-robin segmented chains across the mesh: independent
         # requests' chains execute concurrently on different chips,
         # each on its own per-device launch train (jit follows the
-        # committed args — see launch_steps_bitset_segmented).
-        dev = None
-        if self.mesh is not None:
-            dev = self._devices[next(self._rr) % len(self._devices)]
-        launch = _Launch("segmented", [fut], {})
-        try:
-            launch.handle = bs.launch_steps_bitset_segmented(
-                fut.steps, model=fut.model, S=fut.S,
-                interpret=self.interpret, device=dev,
+        # committed args — see launch_steps_bitset_segmented). The
+        # ladder here degrades by PLACEMENT: a failing chip's chain
+        # re-places on the resharded mesh's pick, then the default
+        # device, then the host oracle.
+        mesh = self.mesh
+        handle = dev = pf = None
+        while handle is None:
+            dev = None
+            if mesh is not None:
+                devs = list(mesh.devices.flat)
+                dev = devs[next(self._rr) % len(devs)]
+            labels = (
+                [str(dev)] if dev is not None else self._labels(None)
             )
-        except BaseException as e:  # noqa: BLE001
-            fut._fail(e)
-            return
+            try:
+                handle = self._guard(
+                    "launch",
+                    lambda: bs.launch_steps_bitset_segmented(
+                        fut.steps, model=fut.model, S=fut.S,
+                        interpret=self.interpret, device=dev,
+                    ),
+                    labels,
+                )
+            except PlaneFault as e:
+                pf = e
+                mesh, exhausted = self._after_fault(mesh)
+                if exhausted:
+                    self._oracle_resolve([fut], pf)
+                    return
+        launch = _Launch("segmented", [fut], {})
+        launch.handle = handle
         _bump_device(
             str(dev if dev is not None else self._devices[0]),
             requests=1, launches=1,
@@ -780,11 +1031,46 @@ class DispatchPlane:
                             _bump("native_wins")
                             f.racer = None
                             f._resolve(out)
-            host = jax.device_get(tuple(L.device_out() for L in prefix))
+            try:
+                # The train's one sync runs guarded: a transient fetch
+                # failure retries, a hung sync times out against
+                # launch_deadline_s (the wedged-plane class this layer
+                # exists for) and retries, and an exhausted budget
+                # degrades every rider below — the collecting thread
+                # and the prep worker always come back.
+                host = self._guard(
+                    "collect",
+                    lambda: jax.device_get(
+                        tuple(L.device_out() for L in prefix)
+                    ),
+                    self._labels(self.mesh),
+                )
+            except PlaneFault as pf:
+                try:
+                    for L in prefix:
+                        self._oracle_resolve(L.futs, pf)
+                        L.resolved = True
+                        for f in L.futs:
+                            f.launch = None
+                            f.steps = None
+                        L.futs = []
+                        L.handle = None
+                finally:
+                    with self._lock:
+                        self._launched = [
+                            L for L in self._launched if not L.resolved
+                        ]
+                return
             try:
                 for L, h in zip(prefix, host):
                     try:
                         self._resolve_launch(L, h)
+                    except PlaneFault as pf:
+                        # A collect-time escalation re-run exhausted
+                        # its guard: this launch's riders degrade to
+                        # the oracle; the rest of the train resolves
+                        # normally.
+                        self._oracle_resolve(L.futs, pf)
                     except BaseException as e:  # noqa: BLE001
                         # A half-resolved launch must not strand
                         # siblings in result() forever: fail the rest,
@@ -950,15 +1236,28 @@ class DispatchPlane:
             DISPATCH_STATS["max_batch"] = max(
                 DISPATCH_STATS["max_batch"], len(futs)
             )
+        def launch_with(m):
+            return bs.launch_keys_bitset(
+                steps_list, model=name, S=S, interpret=interpret,
+                exact=exact, mesh=m,
+            )
+
+        handle, mesh_used, pf = self._dispatch_resilient(
+            launch_with, mesh=use_mesh
+        )
+        if handle is None:
+            # Raw steps carry no events to re-decide on the host: the
+            # structured PlaneFault is the resolution (result() raises
+            # it — never the raw device exception). Every injected
+            # fault class resolves on an earlier rung.
+            self._oracle_resolve(futs, pf)
+            return [f.result() for f in futs]
         launch = _Launch("bitset", futs, {
             "model": name, "S": S, "interpret": interpret,
             "exact": exact,
         })
-        launch.handle = bs.launch_keys_bitset(
-            steps_list, model=name, S=S, interpret=interpret,
-            exact=exact, mesh=use_mesh,
-        )
-        self._note_launch(len(futs), use_mesh)
+        launch.handle = handle
+        self._note_launch(len(futs), mesh_used)
         self._register_launch(launch)
         self._collect_upto(launch)
         return [f.result() for f in futs]
@@ -977,3 +1276,16 @@ def default_plane() -> DispatchPlane:
         if _DEFAULT_PLANE is None:
             _DEFAULT_PLANE = DispatchPlane(async_prep=False)
         return _DEFAULT_PLANE
+
+
+def reset_default_plane() -> None:
+    """Close and discard the process-wide plane (the next
+    default_plane() builds a fresh one over the currently-healthy
+    mesh). The seam chaos tests use to undo a sticky quarantine
+    shrink; operators can use it to re-admit a repaired chip after
+    chaos.reset_resilience()."""
+    global _DEFAULT_PLANE
+    with _default_lock:
+        plane, _DEFAULT_PLANE = _DEFAULT_PLANE, None
+    if plane is not None:
+        plane.close()

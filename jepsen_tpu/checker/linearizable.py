@@ -861,7 +861,7 @@ class LinearizableChecker:
         )
 
         def resolve() -> dict:
-            out = fut.result()
+            out = self._plane_result(fut)
             if fut.events is not None:
                 out.setdefault("n_ops", fut.events.n_ops)
                 out.setdefault("window", fut.events.window)
@@ -875,6 +875,27 @@ class LinearizableChecker:
             return out
 
         return resolve
+
+    def _plane_result(self, fut) -> dict:
+        """Resolve a plane future with the checker-level safety net:
+        the plane's own degradation ladder already absorbs injected
+        fault classes, but an unrecoverable PlaneFault (every rung
+        failed, plane closed mid-flight) still yields the host
+        oracle's verdict here instead of an exception — check() and
+        check_async() NEVER surface a device fault to the caller when
+        the events are on hand to re-decide."""
+        from jepsen_tpu.checker.chaos import PlaneFault
+
+        try:
+            return fut.result()
+        except PlaneFault as pf:
+            if fut.events is None:
+                raise
+            out = _oracle_verdict(
+                *_oracle_decide(fut.events, self.model)
+            )
+            out["degraded"] = pf.describe()
+            return out
 
     def check(self, test, history, opts=None) -> dict:
         from jepsen_tpu.history.history import History
@@ -914,9 +935,9 @@ class LinearizableChecker:
         else:
             if self.use_tpu:
                 if self.plane is not None:
-                    out = self.plane.submit(
-                        events, model=self.model
-                    ).result()
+                    out = self._plane_result(
+                        self.plane.submit(events, model=self.model)
+                    )
                 else:
                     out = check_events_bucketed(events, model=self.model)
             else:

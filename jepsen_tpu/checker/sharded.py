@@ -128,7 +128,13 @@ def key_spec(mesh: Mesh) -> P:
 #: recent one. dryrun_multichip and bench's one-device guard read these
 #: to prove the mesh path actually engaged — MULTICHIP_r03-r05 exited 0
 #: with an empty tail, so a silent fallback to one device must be loud.
-MESH_STATS = {"sharded_launches": 0, "last_n_devices": 0}
+#: "resilience" is the mesh's view of the chaos layer: devices ejected
+#: by quarantine and launches that re-sharded onto the survivors.
+MESH_STATS = {
+    "sharded_launches": 0,
+    "last_n_devices": 0,
+    "resilience": {"quarantined_devices": [], "resharded_launches": 0},
+}
 
 _mesh_stats_lock = threading.Lock()
 
@@ -139,10 +145,27 @@ def note_sharded_launch(n_devices: int) -> None:
         MESH_STATS["last_n_devices"] = int(n_devices)
 
 
+def note_quarantine(label: str) -> None:
+    """Record a device ejection in the mesh's resilience block."""
+    with _mesh_stats_lock:
+        q = MESH_STATS["resilience"]["quarantined_devices"]
+        if label not in q:
+            q.append(label)
+
+
+def note_reshard() -> None:
+    """Record one launch that re-sharded onto surviving devices."""
+    with _mesh_stats_lock:
+        MESH_STATS["resilience"]["resharded_launches"] += 1
+
+
 def reset_mesh_stats() -> None:
     with _mesh_stats_lock:
         MESH_STATS["sharded_launches"] = 0
         MESH_STATS["last_n_devices"] = 0
+        MESH_STATS["resilience"] = {
+            "quarantined_devices": [], "resharded_launches": 0,
+        }
 
 
 def mesh_size(mesh: Mesh) -> int:
@@ -157,16 +180,41 @@ def _mesh_over(devices: tuple) -> Mesh:
 
 
 def default_mesh() -> Optional[Mesh]:
-    """The ambient execution mesh: a 1-D Mesh over every visible device
-    when more than one is visible, else None. check_keys and the
-    dispatch plane consult this when the caller passes mesh=None, so
-    multi-chip hosts (and the tests' virtual 8-device CPU mesh) go
-    sharded by default while a single-device host keeps the exact
-    byte-identical single-device dispatch."""
-    devs = jax.devices()
+    """The ambient execution mesh: a 1-D Mesh over every visible
+    HEALTHY device when more than one is visible, else None. check_keys
+    and the dispatch plane consult this when the caller passes
+    mesh=None, so multi-chip hosts (and the tests' virtual 8-device CPU
+    mesh) go sharded by default while a single-device host keeps the
+    exact byte-identical single-device dispatch. Devices ejected by the
+    resilience layer's quarantine (checker.chaos) are excluded — a
+    fresh auto-mesh re-shards onto the survivors."""
+    from jepsen_tpu.checker.chaos import is_quarantined
+
+    devs = [d for d in jax.devices() if not is_quarantined(str(d))]
     if len(devs) < 2:
         return None
     return _mesh_over(tuple(devs))
+
+
+def mesh_without(mesh: Optional[Mesh], labels) -> Optional[Mesh]:
+    """Re-shard a mesh onto the devices NOT in ``labels`` (the
+    quarantine ejection path): survivors rebuild as a 1-D mesh — the
+    batch pad (launch_keys_bitset's blank rows / stack_streams'
+    padding rows) absorbs the new uneven key split exactly like any
+    other non-multiple batch. Fewer than 2 survivors collapses to None
+    (the single-device path). A mesh with nothing to eject passes
+    through unchanged (same object, so lru-cached wrappers still
+    hit)."""
+    if mesh is None:
+        return None
+    dead = set(labels)
+    devs = list(mesh.devices.flat)
+    survivors = tuple(d for d in devs if str(d) not in dead)
+    if len(survivors) == len(devs):
+        return mesh
+    if len(survivors) < 2:
+        return None
+    return _mesh_over(survivors)
 
 
 def resolve_mesh(mesh) -> Optional[Mesh]:

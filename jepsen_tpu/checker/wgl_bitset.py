@@ -858,8 +858,16 @@ def collect_steps_bitset_segmented(
                 init_frontier(steps.init_state, S, segs[0][2])[None]
             )
             seg_ws = tuple(W for _, _, W in segs)
-            outs2, frs2, _ = _chain_scan(
-                args, fr0, seg_ws, name, S, interpret, True
+            # Collect-time exact re-run: outside the plane's launch
+            # guard, so it runs through its own chaos seam (transient
+            # faults retry; exhaustion raises PlaneFault upward).
+            from jepsen_tpu.checker import chaos
+
+            outs2, frs2, _ = chaos.resilient_call(
+                lambda: _chain_scan(
+                    args, fr0, seg_ws, name, S, interpret, True
+                ),
+                site="launch",
             )
             for o2, f2 in zip(jax.device_get(tuple(outs2)), frs2):
                 alive2, t2, died2 = _out_to_verdicts(np.asarray(o2))[0]
@@ -1099,6 +1107,12 @@ def collect_keys_bitset(handle, out_host=None) -> List[Tuple[bool, bool, int]]:
     # A fast-tier death is provisional: the exact kernel decides. The
     # whole batch re-runs in one launch (device args are already
     # resident; dead keys are rare, so this is the uncommon path).
+    # The re-run happens at COLLECT time, outside the dispatch plane's
+    # launch guard, so it carries its own chaos seam: transient faults
+    # retry here; an exhausted budget raises PlaneFault for the
+    # plane's degradation ladder (or the sequential caller) to absorb.
+    from jepsen_tpu.checker import chaos
+
     _bump_launch("launches")
     _bump_launch("escalations")
     if mesh is not None:
@@ -1110,11 +1124,18 @@ def collect_keys_bitset(handle, out_host=None) -> List[Tuple[bool, bool, int]]:
 
         fn = make_sharded_bitset(mesh, name, S, W, interpret, True)
         note_sharded_launch(mesh_size(mesh))
-        out2, _ = fn(win_j, meta_j, fr0)
+        out2, _ = chaos.resilient_call(
+            lambda: fn(win_j, meta_j, fr0), site="launch",
+            devices=[str(d) for d in mesh.devices.flat],
+        )
     else:
-        out2, _ = _bitset_scan(
-            win_j, meta_j, fr0,
-            model_name=name, S=S, W=W, interpret=interpret, exact=True,
+        out2, _ = chaos.resilient_call(
+            lambda: _bitset_scan(
+                win_j, meta_j, fr0,
+                model_name=name, S=S, W=W, interpret=interpret,
+                exact=True,
+            ),
+            site="launch",
         )
     return _out_to_verdicts(np.asarray(out2))[:n_real]
 

@@ -411,6 +411,55 @@ def _attach_run_log(run_dir) -> None:
         logger.setLevel(logging.INFO)
 
 
+#: default bound on the post-generator worker join (overridable per
+#: test via test["worker_join_timeout_s"]): generous enough for any
+#: legitimate drain, but finite — a wedged client must surface as a
+#: named failure, never block run() forever.
+_JOIN_TIMEOUT_S = 3600.0
+
+#: after poisoning, how long hung workers get to notice and exit
+_JOIN_GRACE_S = 5.0
+
+
+def _join_workers(all_workers, test, sched: Scheduler) -> None:
+    """Bounded worker joins (the unbounded w.join()/nw.join() let one
+    wedged client block the whole run forever). Blowing the budget
+    poisons the scheduler — unblocking every generator-waiting worker —
+    grants a short grace, then records WHICH workers hung in
+    test["hung_workers"] and lets the poison surface from run()."""
+    timeout = float(
+        test.get("worker_join_timeout_s") or _JOIN_TIMEOUT_S
+    )
+    deadline = _time.monotonic() + timeout
+    hung = [w for w in all_workers if not _deadline_join(w, deadline)]
+    if not hung:
+        return
+    names = [w.name for w in hung]
+    sched.poison(RuntimeError(
+        f"worker(s) did not join within {timeout:g}s: "
+        + ", ".join(names)
+    ))
+    grace = _time.monotonic() + float(
+        test.get("worker_join_grace_s") or _JOIN_GRACE_S
+    )
+    still = [w.name for w in hung if not _deadline_join(w, grace)]
+    test["hung_workers"] = still or names
+    import logging
+
+    logging.getLogger(__name__).error(
+        "worker join timed out after %gs; hung: %s%s",
+        timeout, ", ".join(names),
+        " (exited after poison)" if not still else "",
+    )
+
+
+def _deadline_join(w, deadline: float) -> bool:
+    """Join a worker against an absolute monotonic deadline; True if
+    it exited."""
+    w.join(timeout=max(0.0, deadline - _time.monotonic()))
+    return not w.is_alive()
+
+
 def run(test: Dict[str, Any]) -> Dict[str, Any]:
     """Run a test spec end-to-end in-process and analyze the history.
 
@@ -498,9 +547,7 @@ def run(test: Dict[str, Any]) -> Dict[str, Any]:
         for w in workers:
             w.start()
         nw.start()
-        for w in workers:
-            w.join()
-        nw.join()
+        _join_workers(workers + [nw], test, sched)
     finally:
         if nem is not None and hasattr(nem, "teardown"):
             try:

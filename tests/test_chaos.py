@@ -1,0 +1,554 @@
+"""Plane nemesis: differential fault-injection tests for the execution
+plane's resilience layer (checker/chaos.py).
+
+The paper's discipline turned inward: every fault class the nemesis can
+inject — transient launch failure, persistent per-device failure, hung
+sync, OOM — must leave verdicts IDENTICAL to a clean run (the checker
+plane may degrade, never lie), with the recovery visible in
+dispatch_stats()["resilience"]. Fast cases run in tier-1 under the
+``chaos`` marker; the seeded soak is also ``slow``.
+"""
+import random
+import threading
+import time
+
+import jax
+import pytest
+
+from jepsen_tpu.checker import chaos
+from jepsen_tpu.checker import sharded
+from jepsen_tpu.checker import wgl_bitset as bs
+from jepsen_tpu.checker.chaos import (
+    DeadlineExceeded,
+    InjectedXlaRuntimeError,
+    PlaneFault,
+    RetryPolicy,
+)
+from jepsen_tpu.checker.dispatch import (
+    DISPATCH_STATS,
+    DispatchPlane,
+    dispatch_stats,
+    reset_default_plane,
+    reset_dispatch_stats,
+)
+from jepsen_tpu.checker.events import events_to_steps, history_to_events
+from jepsen_tpu.checker.linearizable import LinearizableChecker
+from jepsen_tpu.checker.models import model as get_model
+from jepsen_tpu.history.history import History
+from jepsen_tpu.sim import corrupt_history, gen_register_history
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_nemesis_state():
+    """Quarantine and the resilience ledger are process-global (they
+    must be: real faults outlive any one plane) — every test starts and
+    ends with a clean slate, and the process-wide default plane is
+    rebuilt so a sticky quarantine shrink can't leak across tests."""
+    chaos.clear_chaos()
+    chaos.reset_resilience()
+    sharded.reset_mesh_stats()
+    reset_dispatch_stats()
+    yield
+    chaos.clear_chaos()
+    chaos.reset_resilience()
+    sharded.reset_mesh_stats()
+    reset_dispatch_stats()
+    reset_default_plane()
+
+
+def _register_streams(n, n_ops=80, corrupt_every=0, seed=7000,
+                      p_crash=0.05):
+    streams = []
+    for i in range(n):
+        rng = random.Random(seed + i)
+        h = gen_register_history(
+            rng, n_ops=n_ops, n_procs=4, p_crash=p_crash
+        )
+        if corrupt_every and i % corrupt_every == corrupt_every - 1:
+            h = corrupt_history(h, rng)
+        streams.append(history_to_events(h, model="cas-register"))
+    return streams
+
+
+def _strip(out):
+    """Every verdict field except method/wall — the dispatch-plane
+    differential convention (test_dispatch._strip)."""
+    return {k: v for k, v in out.items() if k not in ("method", "wall_s")}
+
+
+def _run_plane(streams, **kw):
+    kw.setdefault("interpret", True)
+    with DispatchPlane(**kw) as plane:
+        futs = [plane.submit(s) for s in streams]
+        plane.flush()
+        return [f.result() for f in futs]
+
+
+# -- primitives (no device) --------------------------------------------------
+
+
+def test_classify_fault_classes():
+    assert chaos.classify_fault(
+        InjectedXlaRuntimeError("UNAVAILABLE: Socket closed")
+    ) == "transient"
+    assert chaos.classify_fault(
+        InjectedXlaRuntimeError("RESOURCE_EXHAUSTED: out of memory")
+    ) == "oom"
+    assert chaos.classify_fault(DeadlineExceeded("blew budget")) == "deadline"
+    assert chaos.classify_fault(ValueError("boom")) == "fatal"
+
+    class XlaRuntimeError(Exception):  # jaxlib's type-name shape
+        pass
+
+    assert chaos.classify_fault(
+        XlaRuntimeError("INTERNAL: no recognizable marks")
+    ) == "transient"
+
+
+def test_attribute_device_needs_evidence():
+    devs = ["TFRT_CPU_0", "TFRT_CPU_1"]
+    tagged = InjectedXlaRuntimeError("boom", device="CPU_1")
+    assert chaos.attribute_device(tagged, devs) == "TFRT_CPU_1"
+    named = RuntimeError("executable failed on TFRT_CPU_0: bad")
+    assert chaos.attribute_device(named, devs) == "TFRT_CPU_0"
+    # no evidence = no attribution: quarantine never ejects blind
+    assert chaos.attribute_device(RuntimeError("anon"), devs) is None
+
+
+def test_retry_policy_backoff_is_bounded():
+    p = RetryPolicy(max_retries=5, base_delay_s=0.01, multiplier=2.0,
+                    max_delay_s=0.05)
+    delays = [p.delay(a) for a in range(6)]
+    assert delays[0] == 0.01 and delays[1] == 0.02
+    assert all(d <= 0.05 for d in delays)
+    assert delays == sorted(delays)
+
+
+def test_note_device_failure_quarantines_exactly_once():
+    assert chaos.note_device_failure("d0", quarantine_after=3) is False
+    assert chaos.note_device_failure("d0", quarantine_after=3) is False
+    assert chaos.note_device_failure("d0", quarantine_after=3) is True
+    assert chaos.note_device_failure("d0", quarantine_after=3) is False
+    assert chaos.is_quarantined("d0")
+    assert chaos.quarantined_devices() == ("d0",)
+    assert chaos.device_failures()["d0"] == 4
+
+
+def test_run_with_deadline():
+    assert chaos.run_with_deadline(lambda: 7, 5.0) == 7
+    with pytest.raises(DeadlineExceeded):
+        chaos.run_with_deadline(lambda: time.sleep(5), 0.05)
+    with pytest.raises(KeyError):  # the thunk's own errors pass through
+        chaos.run_with_deadline(lambda: {}["missing"], 5.0)
+
+
+def test_resilient_call_retries_transient_then_succeeds():
+    calls = {"n": 0}
+
+    def thunk():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise InjectedXlaRuntimeError("UNAVAILABLE: Socket closed")
+        return "ok"
+
+    out = chaos.resilient_call(
+        thunk, site="launch",
+        policy=RetryPolicy(max_retries=3, base_delay_s=0.0),
+    )
+    assert out == "ok" and calls["n"] == 3
+    assert chaos.RESILIENCE_STATS["retries"] == 2
+
+
+def test_resilient_call_wraps_exhaustion_in_plane_fault():
+    def thunk():
+        raise ValueError("not a device error")
+
+    with pytest.raises(PlaneFault) as ei:
+        chaos.resilient_call(thunk, site="launch")
+    pf = ei.value
+    assert pf.kind == "fatal" and pf.attempts == 1  # fatal: no retry
+    assert isinstance(pf.cause, ValueError)
+    assert pf.describe()["site"] == "launch"
+
+
+def test_chaos_fault_schedule_and_site_matching():
+    with chaos.chaos_plan(chaos.transient_fault(site="launch", times=2)):
+        for _ in range(2):
+            with pytest.raises(InjectedXlaRuntimeError):
+                chaos.inject("launch", ["TFRT_CPU_0"])
+        chaos.inject("launch", ["TFRT_CPU_0"])  # budget spent
+        chaos.inject("collect", [])  # site mismatch never fires
+    chaos.inject("launch", [])  # no plan installed: a no-op
+    assert chaos.RESILIENCE_STATS["faults_injected"] == 2
+
+
+def test_device_scoped_fault_only_matches_its_device():
+    with chaos.chaos_plan(chaos.persistent_device_fault("TFRT_CPU_3")):
+        chaos.inject("launch", ["TFRT_CPU_0", "TFRT_CPU_1"])  # no match
+        with pytest.raises(InjectedXlaRuntimeError) as ei:
+            chaos.inject("launch", ["TFRT_CPU_2", "TFRT_CPU_3"])
+        assert ei.value.chaos_device == "TFRT_CPU_3"
+        with pytest.raises(InjectedXlaRuntimeError):
+            # persistent: any site, forever
+            chaos.inject("collect", ["TFRT_CPU_3"])
+
+
+def test_seeded_probabilistic_plan_is_replayable():
+    def fire_count():
+        n = 0
+        with chaos.chaos_plan(seed=99, p_transient=0.5):
+            for _ in range(64):
+                try:
+                    chaos.inject("launch", [])
+                except InjectedXlaRuntimeError:
+                    n += 1
+        return n
+
+    a = fire_count()
+    chaos.reset_resilience()
+    b = fire_count()
+    assert a == b and 0 < a < 64
+
+
+@pytest.mark.mesh
+def test_mesh_without_ejects_survivors_or_degrades():
+    mesh = sharded.default_mesh()
+    if mesh is None:
+        pytest.skip("needs a multi-device mesh")
+    devs = [str(d) for d in mesh.devices.flat]
+    # nothing to eject: the SAME object back (sharded-fn memos survive)
+    assert sharded.mesh_without(mesh, ()) is mesh
+    smaller = sharded.mesh_without(mesh, (devs[0],))
+    assert smaller is not None
+    assert sharded.mesh_size(smaller) == len(devs) - 1
+    assert devs[0] not in [str(d) for d in smaller.devices.flat]
+    # <2 survivors is not a mesh: the ladder drops to single-device
+    assert sharded.mesh_without(mesh, tuple(devs)) is None
+    assert sharded.mesh_without(mesh, tuple(devs[1:])) is None
+
+
+# -- differential: fault class vs clean verdicts -----------------------------
+#
+# The single-device fault tests share ONE stream family (and the mesh
+# tests another) so interpret-mode kernel shapes compile once and every
+# later test hits the jit cache — tier-1 pays seconds, not minutes.
+
+
+def _solo_streams():
+    # seed chosen so the corrupted streams really are invalid
+    return _register_streams(4, n_ops=40, corrupt_every=2, seed=7120)
+
+
+# The mesh tests ride the SAME streams (padded across the devices) so
+# the 8-wide shape compiles once for all of them.
+_mesh_streams = _solo_streams
+
+
+def test_transient_launch_fault_retries_to_parity():
+    """One transient launch failure: the bounded-backoff retry absorbs
+    it and every verdict matches the clean run field-for-field."""
+    streams = _solo_streams()
+    clean = _run_plane(streams, mesh=False)
+    assert not all(o["valid?"] for o in clean)  # really differential
+    chaos.reset_resilience()
+    with chaos.chaos_plan(chaos.transient_fault(site="launch", times=1)):
+        faulted = _run_plane(streams, mesh=False)
+    for c, f in zip(clean, faulted):
+        assert _strip(c) == _strip(f), (c, f)
+    res = dispatch_stats()["resilience"]
+    assert res["faults_injected"] == 1
+    assert res["retries"] >= 1
+    assert res["quarantined_devices"] == []
+    assert res["oracle_fallbacks"] == 0
+
+
+def test_oom_fault_degrades_placement_to_parity():
+    """An OOM-shaped launch failure is NOT retried (the same shape
+    re-OOMs) — the ladder drops the dispatch to the single-device
+    placement and verdicts are unchanged."""
+    streams = _mesh_streams()
+    clean = _run_plane(streams)
+    chaos.reset_resilience()
+    with chaos.chaos_plan(chaos.oom_fault(site="launch", times=1)):
+        faulted = _run_plane(streams)
+    for c, f in zip(clean, faulted):
+        assert _strip(c) == _strip(f), (c, f)
+    res = dispatch_stats()["resilience"]
+    assert res["faults_injected"] == 1
+    assert res["retries"] == 0  # oom is never retried in place
+    assert res["degradations"] >= 1
+    assert res["oracle_fallbacks"] == 0
+
+
+def test_hang_once_at_collect_deadline_cuts_and_retries():
+    """A hung device sync: the per-call deadline cuts it loose, the
+    retry finds the device healthy again, and the train resolves with
+    verdicts identical to the clean run — the plane never wedges."""
+    streams = _solo_streams()
+    clean = _run_plane(streams, mesh=False)
+    chaos.reset_resilience()
+    with chaos.chaos_plan(
+        chaos.hang_fault(site="collect", times=1, delay_s=30.0)
+    ):
+        faulted = _run_plane(
+            streams, mesh=False, launch_deadline_s=2.0,
+            retry=RetryPolicy(max_retries=3, base_delay_s=0.001),
+        )
+    for c, f in zip(clean, faulted):
+        assert _strip(c) == _strip(f), (c, f)
+    res = dispatch_stats()["resilience"]
+    assert res["deadline_hits"] >= 1
+    assert res["retries"] >= 1
+    assert res["oracle_fallbacks"] == 0
+
+
+def test_persistent_hang_degrades_to_host_oracle():
+    """Every sync hangs forever: the deadline budget exhausts, the
+    ladder runs out of device rungs, and every rider resolves from the
+    host oracle — same valid?/failed_op_index as the clean run, the
+    degradation recorded on the verdict, and result() never raises."""
+    streams = _solo_streams()
+    clean = _run_plane(streams, mesh=False)
+    chaos.reset_resilience()
+    with chaos.chaos_plan(
+        chaos.hang_fault(site="collect", times=None, delay_s=30.0)
+    ):
+        faulted = _run_plane(
+            streams, mesh=False, launch_deadline_s=0.3,
+            retry=RetryPolicy(max_retries=1, base_delay_s=0.001),
+        )
+    for c, f in zip(clean, faulted):
+        assert f["valid?"] == c["valid?"], (c, f)
+        assert f.get("failed_op_index") == c.get("failed_op_index"), (c, f)
+        assert f["method"].startswith("cpu-oracle"), f
+        assert f["degraded"]["kind"] == "deadline"
+    res = dispatch_stats()["resilience"]
+    assert res["deadline_hits"] >= 1
+    assert res["oracle_fallbacks"] == len(streams)
+
+
+@pytest.mark.mesh
+def test_persistent_device_fault_quarantines_and_reshards():
+    """The bad-chip class on the 8-device mesh: attributed failures
+    cross quarantine_after, the chip is ejected, the batch re-shards
+    onto the 7 survivors (the uneven-padding path), and verdicts match
+    the clean 8-device run. The ejection is visible in both stats
+    surfaces."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    target = str(devs[3])
+    streams = _mesh_streams()
+    clean = _run_plane(streams)
+    chaos.reset_resilience()
+    sharded.reset_mesh_stats()
+    reset_dispatch_stats()
+    with chaos.chaos_plan(chaos.persistent_device_fault(target)):
+        faulted = _run_plane(
+            streams, quarantine_after=3,
+            retry=RetryPolicy(max_retries=3, base_delay_s=0.001),
+        )
+    for c, f in zip(clean, faulted):
+        assert _strip(c) == _strip(f), (c, f)
+    res = dispatch_stats()["resilience"]
+    assert target in res["quarantined_devices"]
+    assert res["retries"] >= 3
+    assert res["oracle_fallbacks"] == 0
+    assert sharded.MESH_STATS["resilience"]["quarantined_devices"] == [
+        target
+    ]
+    assert sharded.MESH_STATS["resilience"]["resharded_launches"] >= 1
+
+
+def test_checker_check_and_check_async_survive_faults():
+    """The acceptance surface: LinearizableChecker.check/check_async
+    through a faulted plane return verdicts identical to the plane-less
+    checker — no raw exception ever crosses the resolver, even when
+    every device rung is dead."""
+    rng = random.Random(46)
+    hs = []
+    for i in range(3):
+        h = gen_register_history(rng, n_ops=60, n_procs=3)
+        if i == 1:
+            h = corrupt_history(h, rng)
+        hs.append(History(h.ops if hasattr(h, "ops") else h))
+    base = LinearizableChecker(model="cas-register")
+    seq = [base.check({}, h) for h in hs]
+    with chaos.chaos_plan(
+        chaos.hang_fault(site="collect", times=None, delay_s=30.0)
+    ):
+        with DispatchPlane(
+            interpret=True, launch_deadline_s=0.3,
+            retry=RetryPolicy(max_retries=1, base_delay_s=0.001),
+        ) as plane:
+            c = LinearizableChecker(model="cas-register", plane=plane)
+            direct = c.check({}, hs[0])
+            resolvers = [c.check_async({}, h) for h in hs]
+            plane.flush()
+            outs = [r() for r in resolvers]
+    for s, p in zip([seq[0]] + seq, [direct] + outs):
+        assert p["valid?"] == s["valid?"], (s, p)
+        assert p.get("failed_op_index") == s.get("failed_op_index")
+        assert "degraded" in p  # the fallback is disclosed, not hidden
+
+
+def test_check_keys_bitset_transient_parity():
+    """The steps-level entry (run_keys): a transient launch fault on
+    the single-device path retries to byte-identical raw verdicts."""
+    streams, steps, S = _bitset_batch()
+    clean = bs.check_keys_bitset(steps, S=S, interpret=True, mesh=False)
+    chaos.reset_resilience()
+    with chaos.chaos_plan(chaos.transient_fault(site="launch", times=1)):
+        faulted = bs.check_keys_bitset(
+            steps, S=S, interpret=True, mesh=False
+        )
+    assert list(clean) == list(faulted)
+    res = chaos.resilience_snapshot()
+    assert res["retries"] >= 1 and res["faults_injected"] == 1
+
+
+@pytest.mark.mesh
+def test_check_keys_bitset_quarantine_parity_on_default_plane():
+    """Same entry through the process-wide plane's auto mesh: a
+    persistent device fault quarantines the chip mid-batch and the
+    resharded batch returns the same raw verdicts."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    streams, steps, S = _bitset_batch()
+    clean = bs.check_keys_bitset(steps, S=S, interpret=True)
+    chaos.reset_resilience()
+    sharded.reset_mesh_stats()
+    target = str(devs[5])
+    with chaos.chaos_plan(chaos.persistent_device_fault(target)):
+        faulted = bs.check_keys_bitset(steps, S=S, interpret=True)
+    assert list(clean) == list(faulted)
+    assert target in chaos.quarantined_devices()
+    assert sharded.MESH_STATS["resilience"]["quarantined_devices"] == [
+        target
+    ]
+
+
+def _bitset_batch():
+    """The plane's stream family as same-W steps + shared S — the
+    check_keys_bitset calling convention (test_bitset's batch
+    construction), on shapes the plane tests already compiled."""
+    streams = _solo_streams()
+    W = max(bs.w_bucket(max(s.window, 1)) for s in streams)
+    m = get_model("cas-register")
+    S = bs._rows_bucket(
+        max(m.bitset_rows(len(s.value_codes)) for s in streams)
+    )
+    steps = [events_to_steps(s, W=W) for s in streams]
+    return streams, steps, S
+
+
+# -- lifecycle: leaks never drop riders --------------------------------------
+
+
+def test_close_detects_worker_leak_and_resolves_pending():
+    """A prep worker that never joins (wedged behind a hung device
+    call) must not hang close() or strand futures: close() returns
+    within its budget and every pending future resolves with a
+    structured PlaneFault, counted in pending_at_close."""
+    streams = _register_streams(2, n_ops=30, seed=7800, p_crash=0.0)
+    release = threading.Event()
+    plane = DispatchPlane(
+        interpret=True, async_prep=True, worker_join_s=0.3
+    )
+    plane._pump = lambda *a, **k: release.wait()  # the wedge stand-in
+    try:
+        futs = [plane.submit(s) for s in streams]
+        t0 = time.perf_counter()
+        plane.close()
+        assert time.perf_counter() - t0 < 5.0  # bounded, not forever
+        for f in futs:
+            with pytest.raises(PlaneFault) as ei:
+                f.result()
+            assert ei.value.kind == "worker-leak"
+        assert DISPATCH_STATS["pending_at_close"] == len(futs)
+    finally:
+        release.set()  # let the leaked thread exit
+
+
+def test_run_surfaces_hung_worker_by_name():
+    """runtime satellite: a client that blocks forever must not block
+    run() forever — the bounded join poisons the scheduler and run()
+    raises naming the hung worker thread."""
+    from jepsen_tpu.generator import pure as gen
+    from jepsen_tpu.runtime import Client, run
+
+    release = threading.Event()
+
+    class BlockingClient(Client):
+        def open(self, test, node):
+            return self
+
+        def setup(self, test):
+            pass
+
+        def invoke(self, test, op):
+            release.wait()
+
+        def teardown(self, test):
+            pass
+
+        def close(self, test):
+            pass
+
+    try:
+        with pytest.raises(RuntimeError, match="jepsen-worker-0"):
+            run({
+                "name": "hung-worker",
+                "client": BlockingClient(),
+                "generator": gen.clients(gen.limit(1, {"f": "read"})),
+                "concurrency": 1,
+                "worker_join_timeout_s": 0.5,
+                "worker_join_grace_s": 0.2,
+            })
+    finally:
+        release.set()
+
+
+# -- seeded soak -------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_seeded_chaos_soak_parity():
+    """Traffic-shaped nemesis: a seeded probabilistic transient plan
+    plus scheduled faults over 24 mixed streams through the async-prep
+    plane. Verdicts must match the clean run on every stream, and the
+    prep worker must have swallowed zero exceptions."""
+    streams = []
+    for i in range(24):
+        rng = random.Random(9900 + i)
+        h = gen_register_history(
+            rng, n_ops=60 + (i % 4) * 30, n_procs=4,
+            p_crash=0.25 if i % 6 == 0 else 0.02,
+        )
+        if i % 4 == 1:
+            h = corrupt_history(h, rng)
+        streams.append(history_to_events(h, model="cas-register"))
+    clean = _run_plane(streams)
+    chaos.reset_resilience()
+    reset_dispatch_stats()
+    with chaos.chaos_plan(
+        chaos.transient_fault(site="launch", times=2),
+        chaos.oom_fault(site="launch", times=1),
+        seed=1234, p_transient=0.15,
+    ):
+        faulted = _run_plane(
+            streams, async_prep=True,
+            retry=RetryPolicy(max_retries=4, base_delay_s=0.001),
+        )
+    for i, (c, f) in enumerate(zip(clean, faulted)):
+        assert f["valid?"] == c["valid?"], (i, c, f)
+        assert f.get("failed_op_index") == c.get("failed_op_index"), (
+            i, c, f,
+        )
+    res = dispatch_stats()["resilience"]
+    assert res["faults_injected"] >= 3  # the scheduled ones at least
+    assert res["retries"] >= 1
+    assert DISPATCH_STATS["worker_errors"] == 0

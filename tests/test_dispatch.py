@@ -467,6 +467,7 @@ def test_dispatch_differential_soak():
         for s in streams
     ]
     seq_q = [check_queue_by_value(q, "unordered-queue") for q in qhs]
+    reset_dispatch_stats()
     with DispatchPlane(interpret=True, async_prep=True) as plane:
         futs = [plane.submit(s) for s in streams]
         q_outs = [
@@ -478,3 +479,6 @@ def test_dispatch_differential_soak():
         assert _strip(s) == _strip(p), (i, s, p)
     for s, p in zip(seq_q, q_outs):
         assert s["valid?"] == p["valid?"]
+    # The prep worker swallowed nothing: every exception it caught is
+    # counted, and a clean soak must count zero.
+    assert DISPATCH_STATS["worker_errors"] == 0

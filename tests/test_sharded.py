@@ -86,6 +86,10 @@ def test_graft_entry_contract(capfd):
     assert rec["value"] > 0
     assert rec["scaling_efficiency"] >= 0.6
     assert rec["mesh_wall_s"] > 0 and rec["single_wall_s"] > 0
+    # Resilience accounting rides the same line: a clean dryrun
+    # publishes integer zeros (nonzero means faults were survived).
+    assert isinstance(rec["retries"], int) and rec["retries"] >= 0
+    assert isinstance(rec["quarantines"], int) and rec["quarantines"] >= 0
 
 
 def test_sharded_at_scale_with_escalation_keys():
