@@ -216,6 +216,9 @@ def dispatch_stats() -> dict:
     res = chaos.resilience_snapshot()
     res["worker_errors"] = out["worker_errors"]
     out["resilience"] = res
+    from jepsen_tpu.checker.checkpoint import checkpoint_stats
+
+    out["checkpoint"] = checkpoint_stats()
     return out
 
 
@@ -230,6 +233,7 @@ class CheckFuture:
         self.plane = plane
         self.events = events
         self.model = model  # original model name (racer + fallbacks)
+        self.checkpoint = None  # durable-analysis sink (submit(...))
         self.kind: Optional[str] = None
         self.kernel_model = model  # post packed-substitution
         self.steps = None
@@ -389,10 +393,19 @@ class DispatchPlane:
 
     # -- submission ----------------------------------------------------
 
-    def submit(self, events: EventStream, model: Optional[str] = None
-               ) -> CheckFuture:
-        """Queue one event-stream check; returns its CheckFuture."""
+    def submit(self, events: EventStream, model: Optional[str] = None,
+               checkpoint=None) -> CheckFuture:
+        """Queue one event-stream check; returns its CheckFuture.
+
+        checkpoint: a checkpoint.CheckpointSink makes this check
+        durable — it resolves through the segment-at-a-time
+        checkpointed driver (check_events_bucketed(checkpoint=...))
+        instead of riding a coalesced batch: durability means a host
+        sync per segment, which is incompatible with sharing one
+        launch train, so checkpointed checks trade coalescing for
+        crash-safe resume."""
         fut = CheckFuture(self, events, model or self.model)
+        fut.checkpoint = checkpoint
         _bump("requests")
         if self._worker is not None:
             with self._lock:
@@ -596,6 +609,12 @@ class DispatchPlane:
         tier order exactly (bitset plan on the ORIGINAL model, then
         packed substitution, then the K-ladder envelope)."""
         ev = fut.events
+        if fut.checkpoint is not None:
+            # Durable check: resolved via the checkpointed segmented
+            # driver on the collecting thread (the fallback rail — no
+            # coalescing; see submit()).
+            fut.kind = "fallback"
+            return
         m = get_model(fut.model)
         device_ok = _on_tpu() or self.interpret
         plan = (
@@ -1194,6 +1213,7 @@ class DispatchPlane:
                 out = check_events_bucketed(
                     f.events, model=f.model, race=False,
                     interpret=self.interpret,
+                    checkpoint=f.checkpoint,
                 )
             except BaseException as e:  # noqa: BLE001
                 f._fail(e)

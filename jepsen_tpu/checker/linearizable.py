@@ -388,6 +388,7 @@ def check_events_bucketed(
     k_ladder=K_LADDER,
     race: Optional[bool] = None,
     interpret: bool = False,
+    checkpoint=None,
 ) -> dict:
     """Definite linearizability verdict for an event stream.
 
@@ -403,6 +404,14 @@ def check_events_bucketed(
     interpret: run the bitset kernel in Pallas interpret mode on CPU —
     the tests' seam for exercising the device branch (race logic,
     launch accounting, escalation) without a TPU.
+
+    checkpoint: a checkpoint.CheckpointSink routes the bitset tier
+    through the durable segment-at-a-time driver (one launch per
+    segment, every verified boundary persisted, crash-safe resume —
+    see wgl_bitset.check_steps_bitset_segmented_checkpointed). The
+    racer is disabled for checkpointed checks (a native win would
+    leave no durable trail). Only the bitset envelope checkpoints;
+    out-of-envelope streams ignore the sink and run their usual path.
     """
     from jepsen_tpu.checker.models import model as get_model
 
@@ -428,6 +437,36 @@ def check_events_bucketed(
 
         bW, S = plan
         bsteps = events_to_steps(events, W=bW)  # memoized per stream
+        if checkpoint is not None:
+            from jepsen_tpu.checker.wgl_bitset import (
+                check_steps_bitset_segmented,
+            )
+
+            alive, taint, died = check_steps_bitset_segmented(
+                bsteps, model=model, S=S, interpret=interpret,
+                checkpoint=checkpoint,
+            )
+            if not taint:
+                out = {
+                    "valid?": alive,
+                    "method": "tpu-wgl-bitset",
+                    "frontier_k": None,
+                    "escalations": 0,
+                    "checkpoint": checkpoint.summary(),
+                }
+                if not alive:
+                    out["failed_op_index"] = died
+                    fr = getattr(bsteps, "_death_frontier", None)
+                    if fr is not None:
+                        from jepsen_tpu.checker.wgl_bitset import (
+                            decode_frontier,
+                        )
+
+                        out["failure"] = decode_frontier(
+                            fr, bsteps, died, model,
+                            decode_value=_decode_value(events),
+                        )
+                return out
         # Segment-aware: the prefix before crashes widen the window
         # runs on the narrow (16x cheaper) kernel; padding/bucketing
         # happens per segment inside.
@@ -740,7 +779,8 @@ def split_queue_history_by_value(history):
 
 
 def check_queue_by_value(history, model: str, init_value=None,
-                         plane=None, mesh=None):
+                         plane=None, mesh=None, validate=True,
+                         strict=False):
     """Batched per-value queue check (split_queue_history_by_value),
     or None when the history doesn't decompose / a subhistory blows
     the window. Verdict merge: valid iff every value is; the first
@@ -756,7 +796,18 @@ def check_queue_by_value(history, model: str, init_value=None,
     sharded.resolve_mesh semantics — None auto-shards over every
     visible device when more than one is visible, False pins one
     device, a Mesh is explicit. A plane carries its own mesh, so
-    mesh is ignored when plane is given."""
+    mesh is ignored when plane is given.
+
+    validate: run the history sentry first (history/sentry.py) —
+    clean histories pass through untouched; repaired ones carry a
+    history_report in the verdict. LinearizableChecker.check already
+    validated and passes False. strict: raise HistorySentryError
+    instead of repairing."""
+    hreport = None
+    if validate:
+        from jepsen_tpu.history.sentry import validate_history
+
+        history, hreport = validate_history(history, strict=strict)
     subs = split_queue_history_by_value(history)
     if subs is None or not subs:
         return None
@@ -795,6 +846,8 @@ def check_queue_by_value(history, model: str, init_value=None,
         "frontier_k": None,
         "escalations": sum(r.get("escalations", 0) for r in results),
     }
+    if hreport is not None and not hreport.get("clean"):
+        out["history_report"] = hreport
     for v, r in zip(streams, results):
         if r["valid?"] is False:
             detail = check_events_bucketed(streams[v], model=model)
@@ -827,6 +880,9 @@ class LinearizableChecker:
         use_tpu: bool = True,
         plane=None,
         mesh=None,
+        interpret: bool = False,
+        sentry: bool = True,
+        strict_history: bool = False,
     ):
         self.model = model
         self.init_value = init_value
@@ -842,6 +898,30 @@ class LinearizableChecker:
         # device, a Mesh is explicit. A configured plane already
         # carries its own mesh and ignores this.
         self.mesh = mesh
+        # Pallas interpret mode: the device branch (bitset tier,
+        # checkpointed driver included) on CPU — the analyze seam's
+        # test hook and the checkpoint/resume path's CPU fallback.
+        self.interpret = interpret
+        # History sentry (history/sentry.py): validate/repair the
+        # history before encoding. Clean histories pass through
+        # zero-copy; repaired ones attach a history_report to the
+        # verdict. strict_history raises HistorySentryError instead
+        # of repairing (analyze --strict-history, exit code 3).
+        self.sentry = sentry
+        self.strict_history = strict_history
+
+    def _sentry(self, history):
+        """(validated history, report-or-None) per the sentry flags."""
+        if not self.sentry:
+            return history, None
+        from jepsen_tpu.history.sentry import validate_history
+
+        return validate_history(history, strict=self.strict_history)
+
+    @staticmethod
+    def _attach_report(out: dict, hreport) -> None:
+        if hreport is not None and not hreport.get("clean"):
+            out["history_report"] = hreport
 
     def check_async(self, test, history, opts=None):
         """Submit this history to the configured dispatch plane and
@@ -856,6 +936,7 @@ class LinearizableChecker:
         if not isinstance(history, History):
             history = History(history)
         t0 = time.perf_counter()
+        history, hreport = self._sentry(history)
         fut = self.plane.submit_history(
             history, model=self.model, init_value=self.init_value
         )
@@ -870,6 +951,7 @@ class LinearizableChecker:
                 # before the SVG render, so the async path yields the
                 # same dict (and artifact) the synchronous one would.
                 _harvest_failure(fut.events, out, self.model)
+            self._attach_report(out, hreport)
             out["wall_s"] = time.perf_counter() - t0
             self._render_failure(test, out, opts)
             return out
@@ -897,12 +979,20 @@ class LinearizableChecker:
             out["degraded"] = pf.describe()
             return out
 
-    def check(self, test, history, opts=None) -> dict:
+    def check(self, test, history, opts=None, checkpoint=None) -> dict:
+        """checkpoint: a checkpoint.CheckpointSink makes the bitset
+        tier durable — every verified segment boundary persists
+        atomically, and re-running the same check (same history,
+        model, plan) resumes at the last durable frontier instead of
+        starting over (the `analyze --resume` engine). Ignored by
+        tiers that don't segment (K-ladder, oracle, queue-by-value).
+        """
         from jepsen_tpu.history.history import History
 
         if not isinstance(history, History):
             history = History(history)
         t0 = time.perf_counter()
+        history, hreport = self._sentry(history)
         if self.model == "unordered-queue" and self.use_tpu:
             # Queue histories decompose by value (locality — see
             # split_queue_history_by_value): one batched kernel pass
@@ -910,10 +1000,11 @@ class LinearizableChecker:
             # packed envelope real value domains immediately exceed.
             out = check_queue_by_value(
                 history, self.model, init_value=self.init_value,
-                plane=self.plane, mesh=self.mesh,
+                plane=self.plane, mesh=self.mesh, validate=False,
             )
             if out is not None:
                 out["n_ops"] = len(history)
+                self._attach_report(out, hreport)
                 out["wall_s"] = time.perf_counter() - t0
                 self._render_failure(test, out, opts)
                 return out
@@ -936,10 +1027,17 @@ class LinearizableChecker:
             if self.use_tpu:
                 if self.plane is not None:
                     out = self._plane_result(
-                        self.plane.submit(events, model=self.model)
+                        self.plane.submit(
+                            events, model=self.model,
+                            checkpoint=checkpoint,
+                        )
                     )
                 else:
-                    out = check_events_bucketed(events, model=self.model)
+                    out = check_events_bucketed(
+                        events, model=self.model,
+                        interpret=self.interpret,
+                        checkpoint=checkpoint,
+                    )
             else:
                 out = _oracle_verdict(
                     *_oracle_decide(events, self.model)
@@ -950,6 +1048,7 @@ class LinearizableChecker:
         # return only the failing index (K-frontier rungs, the native
         # oracle) get theirs harvested from the Python oracle.
         _harvest_failure(events, out, self.model)
+        self._attach_report(out, hreport)
         out["wall_s"] = time.perf_counter() - t0
         self._render_failure(test, out, opts)
         return out

@@ -879,19 +879,127 @@ def collect_steps_bitset_segmented(
     return True, taint, -1
 
 
+def check_steps_bitset_segmented_checkpointed(
+    steps: ReturnSteps,
+    sink,
+    model: str = "cas-register",
+    S: int = 8,
+    interpret: bool = False,
+    min_len: int | None = None,
+) -> Tuple[bool, bool, int]:
+    """Durable segment-at-a-time variant of the segmented scan: each
+    segment dispatches on its own (one launch per segment — the price
+    of a durable boundary is a host sync, which is why this path is
+    opt-in via a checkpoint.CheckpointSink), and every verified
+    boundary's frontier persists atomically before the next segment
+    starts. A killed process re-enters at the last durable frontier
+    and re-runs only unverified segments; a finished checkpoint
+    replays its verdict with ZERO launches.
+
+    Soundness: a fast-tier boundary frontier equals the uninterrupted
+    chain's (same kernels, same inputs), and fast ALIVE verdicts are
+    definite — so fast boundaries are safe resume points. A fast-tier
+    DEATH is provisional: the sink invalidates back to segment 0
+    (restart-from-segment-0 semantics, durably recording the
+    escalation) and the exact pass checkpoints its own, fully-closed
+    frontiers. Stale or tampered checkpoints (content hash mismatch)
+    are rejected in sink.begin() and the check runs cold."""
+    from jepsen_tpu.checker import chaos
+    from jepsen_tpu.checker import checkpoint as _cp
+
+    min_len = min_len if min_len is not None else sink.seg_min_len
+    segs = _plan_for(steps, min_len)
+    name = model if isinstance(model, str) else model.name
+    chash = _cp.steps_content_hash(steps, name, S, segs)
+    state = sink.begin(chash, segs, name, S)
+    v = state.get("verdict")
+    if v is not None:
+        # Finished checkpoint: replay, zero launches.
+        fr = sink.death_frontier_array()
+        if fr is not None:
+            steps._death_frontier = fr
+        return bool(v["alive"]), bool(v["taint"]), int(v["died"])
+    exact = bool(state.get("exact", False))
+    start = int(state.get("segments_done", 0))
+    fr_host = sink.frontier_array()
+    taint = False
+    while True:  # one iteration per tier; escalation restarts the loop
+        if start == 0 or fr_host is None:
+            start = 0
+            fr_host = init_frontier(steps.init_state, S, segs[0][2])[None]
+        k = start
+        escalated = False
+        while k < len(segs):
+            seg = segs[k]
+            args = _segment_args(steps, [seg])
+            fr0 = jnp.asarray(fr_host)
+            _bump_launch("launches")
+            run_exact = exact
+
+            def one_segment(a=args, f=fr0, W=seg[2], ex=run_exact):
+                outs, frs, _ = _chain_scan(
+                    a, f, (W,), name, S, interpret, ex
+                )
+                return (
+                    jax.device_get(outs[0]), jax.device_get(frs[0])
+                )
+            # Same chaos seam as the plain collect path: transient
+            # faults retry, exhaustion raises PlaneFault upward.
+            o_host, fr_host = chaos.resilient_call(
+                one_segment, site="launch"
+            )
+            o_host = np.asarray(o_host)
+            fr_host = np.asarray(fr_host)
+            alive, t, died = _out_to_verdicts(o_host)[0]
+            taint = taint or t
+            if not alive:
+                if not exact:
+                    # Provisional fast death: every fast checkpoint is
+                    # void — durably escalate, restart from segment 0.
+                    _bump_launch("escalations")
+                    exact = True
+                    sink.invalidate(reason="exact-escalation")
+                    fr_host = None
+                    escalated = True
+                    break
+                steps._death_frontier = fr_host[0]
+                sink.finish(
+                    alive=False, taint=taint, died=died,
+                    death_frontier=fr_host[0],
+                )
+                return False, taint, died
+            k += 1
+            sink.record(segments_done=k, frontier=fr_host, exact=exact)
+        if escalated:
+            start = 0
+            continue
+        sink.finish(alive=True, taint=taint, died=-1)
+        return True, taint, -1
+
+
 def check_steps_bitset_segmented(
     steps: ReturnSteps,
     model: str = "cas-register",
     S: int = 8,
     interpret: bool = False,
     min_len: int | None = None,
+    checkpoint=None,
 ) -> Tuple[bool, bool, int]:
     """Multi-segment scan for crash-accumulating histories: the prefix
     runs on the narrowest kernel its windows fit (per-op cost scales
     16x per bucket), widening as crashed slots pile up, all segments
     chained through the frontier in/out pair with NO host sync in
     between — ONE dispatch for the whole plan. The host fetches every
-    segment's verdict in one device_get; the first death wins."""
+    segment's verdict in one device_get; the first death wins.
+
+    checkpoint: a checkpoint.CheckpointSink switches to the durable
+    segment-at-a-time driver (one launch per segment, every boundary
+    persisted — see check_steps_bitset_segmented_checkpointed)."""
+    if checkpoint is not None:
+        return check_steps_bitset_segmented_checkpointed(
+            steps, checkpoint, model=model, S=S, interpret=interpret,
+            min_len=min_len,
+        )
     segs = _plan_for(steps, min_len)
     if len(segs) == 1:
         # Not worth multiple launches: one scan, shape-bucketed. The

@@ -23,6 +23,11 @@ from typing import Any, Dict, List, Optional
 EXIT_VALID = 0
 EXIT_INVALID = 1
 EXIT_UNKNOWN = 2
+#: the stored history itself failed strict sentry validation — a
+#: distinct failure from an invalid VERDICT (the history was readable
+#: and the checker found a consistency violation) and from unknown
+#: (the checker could not decide). See history/sentry.py.
+EXIT_HOSTILE_HISTORY = 3
 EXIT_CRASH = 254
 EXIT_USAGE = 255
 
@@ -84,6 +89,8 @@ def _workload_spec(args, rng: random.Random) -> Dict[str, Any]:
 
 
 def _checker_for(workload: str):
+    import os
+
     from jepsen_tpu import independent
     from jepsen_tpu.checker.adya import G2Checker
     from jepsen_tpu.checker.bank import BankChecker
@@ -94,11 +101,16 @@ def _checker_for(workload: str):
     from jepsen_tpu.checker.reductions import CounterChecker, SetFullChecker
     from jepsen_tpu.workloads.adya import _KVG2Checker
 
+    # Pallas interpret mode for the linearizable tiers: the seam that
+    # exercises the device branch (segmented scan, checkpoint/resume)
+    # on a CPU-only host — the kill-restart nemesis test runs
+    # `analyze --resume` subprocesses under this.
+    interp = os.environ.get("JEPSEN_TPU_INTERPRET", "") not in ("", "0")
     return {
         "set": SetFullChecker(),
-        "register": LinearizableChecker(),
+        "register": LinearizableChecker(interpret=interp),
         "register-keyed": independent.independent_checker(
-            LinearizableChecker()
+            LinearizableChecker(interpret=interp)
         ),
         "bank": BankChecker(),
         "long-fork": LongForkChecker(2),
@@ -120,11 +132,27 @@ def _exit_code(results: Optional[dict]) -> int:
     return EXIT_UNKNOWN  # "unknown" verdicts (cli.clj:272-283)
 
 
+def _reset_engine_state() -> None:
+    """Clean resilience slate at command entry: a quarantine ledger or
+    a sticky-shrunk default plane left by a prior in-process run (or
+    an embedding test harness) must not shadow THIS run's mesh; stats
+    reset so the engine_stats this command reports are its own."""
+    from jepsen_tpu.checker import chaos, dispatch
+    from jepsen_tpu.checker import wgl_bitset as bs
+    from jepsen_tpu.checker.checkpoint import reset_checkpoint_stats
+
+    chaos.reset_resilience()
+    dispatch.reset_default_plane()
+    bs.reset_launch_stats()
+    reset_checkpoint_stats()
+
+
 def cmd_test(args) -> int:
     from jepsen_tpu import store as storelib
     from jepsen_tpu.generator import pure as gen
     from jepsen_tpu.runtime import run
 
+    _reset_engine_state()
     rng = random.Random(args.seed)
     nodes = parse_nodes(args)
     worst = EXIT_VALID
@@ -174,9 +202,27 @@ def _resolve_run_dir(path: str, store_root: str) -> str:
 
 def cmd_analyze(args) -> int:
     """Re-check a stored history — the checkpoint/resume seam for the
-    analysis phase (cli.clj:366-397)."""
+    analysis phase (cli.clj:366-397).
+
+    --strict-history: refuse (exit code 3, distinct message) instead
+    of repairing when the stored history fails sentry validation.
+
+    --resume: run the check durably — verified segment boundaries
+    persist atomically into <run_dir>/checkpoint.json, and a re-run
+    after a crash re-enters at the last durable frontier (stale or
+    tampered checkpoints are rejected and the check runs cold).
+    engine_stats in results.json carries the launch + checkpoint
+    accounting so a resumed run's strictly-fewer launches are
+    auditable."""
+    import os
+
+    from jepsen_tpu.history.sentry import (
+        HistorySentryError,
+        validate_history,
+    )
     from jepsen_tpu.store import Store
 
+    _reset_engine_state()
     run_dir = _resolve_run_dir(args.path, args.store)
     st = Store(args.store)
     history = st.load_history(run_dir)
@@ -185,13 +231,56 @@ def cmd_analyze(args) -> int:
     # run_dir (runs relocated via zip export), and artifact-writing
     # checkers (linear.svg, timeline) target test["run_dir"].
     test["run_dir"] = run_dir
+    # Sentry gate ahead of EVERY checker (linearizable runs its own
+    # pass too, but bank/set/etc. get validated history only here).
+    try:
+        history, hreport = validate_history(
+            history, strict=args.strict_history
+        )
+    except HistorySentryError as e:
+        print(f"analyzed {run_dir}: hostile history — {e}")
+        print(_epitaph(EXIT_HOSTILE_HISTORY))
+        return EXIT_HOSTILE_HISTORY
     checker = _checker_for(args.workload)
-    results = checker.check(test, history, {})
+    checkpoint = None
+    if args.resume:
+        from jepsen_tpu.checker.checkpoint import CheckpointSink
+
+        seg_env = os.environ.get("JEPSEN_TPU_SEG_MIN_LEN")
+        checkpoint = CheckpointSink(
+            run_dir,
+            seg_min_len=int(seg_env) if seg_env else None,
+        )
+    import inspect
+
+    kw = {}
+    if (
+        checkpoint is not None
+        and "checkpoint" in inspect.signature(checker.check).parameters
+    ):
+        kw["checkpoint"] = checkpoint
+    results = checker.check(test, history, {}, **kw)
+    if hreport is not None and not hreport.get("clean"):
+        results.setdefault("history_report", hreport)
+    results["engine_stats"] = _engine_stats()
     test["results"] = results
     st.save_2(test)
     print(f"analyzed {run_dir}: valid?={results.get('valid?')}")
     print(_epitaph(_exit_code(results)))
     return _exit_code(results)
+
+
+def _engine_stats() -> dict:
+    """Launch + checkpoint accounting for results.json — the cross-
+    process audit trail the kill-restart differential reads (a
+    resumed run shows strictly fewer launches than the cold one)."""
+    from jepsen_tpu.checker import wgl_bitset as bs
+    from jepsen_tpu.checker.checkpoint import checkpoint_stats
+
+    return {
+        "launch": dict(bs.LAUNCH_STATS),
+        "checkpoint": checkpoint_stats(),
+    }
 
 
 def cmd_serve(args) -> int:
@@ -207,6 +296,11 @@ def _epitaph(code: int) -> str:
         return "Everything looks good! (code 0)"
     if code == EXIT_INVALID:
         return "Analysis invalid! (code 1)"
+    if code == EXIT_HOSTILE_HISTORY:
+        return (
+            "Stored history failed validation; no verdict issued. "
+            "(code 3)"
+        )
     return "Errors occurred during analysis; verdict unknown. (code 2)"
 
 
@@ -247,6 +341,13 @@ def build_parser() -> argparse.ArgumentParser:
     shared(a)
     a.add_argument("path", nargs="?", default="",
                    help="run directory or test name (default: latest)")
+    a.add_argument("--resume", action="store_true",
+                   help="durable check: persist segment checkpoints "
+                        "into the run dir and resume a killed "
+                        "analysis at its last verified frontier")
+    a.add_argument("--strict-history", action="store_true",
+                   help="refuse (exit 3) instead of repairing when "
+                        "the stored history fails sentry validation")
     a.set_defaults(fn=cmd_analyze)
 
     s = sub.add_parser("serve", help="web dashboard over the store")

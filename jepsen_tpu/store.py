@@ -11,6 +11,12 @@ JSON Lines (one op per line — append-friendly, streamable, and loadable
 straight into the columnar plane), test/results as JSON. Fressian's
 custom type handlers become a small tag scheme (__kv__ for independent
 tuples, __tuple__ for tuples, __set__ for sets).
+
+Every write is crash-safe: temp file + fsync + atomic rename + dir
+fsync (atomic_write_text), and the latest/current symlinks swap via
+temp-symlink + rename — a SIGKILL at any instant leaves the old state
+or the new one, never a torn file. checker/checkpoint.py rides the
+same primitive for mid-check segment checkpoints.
 """
 
 from __future__ import annotations
@@ -24,6 +30,58 @@ from jepsen_tpu.history.history import History
 from jepsen_tpu.history.ops import Op
 
 DEFAULT_ROOT = "store"
+
+
+# -- crash-safe writes -------------------------------------------------
+#
+# Two-phase discipline: serialize into a temp file in the SAME
+# directory, fsync the file, rename over the destination, fsync the
+# directory. A crash at any point leaves either the old state or the
+# new one — never a torn file (rename(2) is atomic within a
+# filesystem; the directory fsync makes the rename itself durable).
+
+
+def _fsync_dir(path: str) -> None:
+    """Flush a directory entry to disk; a rename is only durable once
+    its directory is. No-op on filesystems that refuse O_RDONLY dir
+    fds (some network mounts)."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_text(path: str, data: str) -> None:
+    """Durably replace `path` with `data`: tmp + fsync + rename +
+    dir fsync. The tmp name carries the pid so concurrent writers
+    (two analyzers on one run dir) never clobber each other's
+    in-flight temp."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(os.path.dirname(path))
+
+
+def atomic_write_json(path: str, obj: Any) -> None:
+    atomic_write_text(
+        path, json.dumps(_encode_value(obj), indent=2, default=str)
+    )
 
 #: single-key shapes reserved by the tag scheme: a genuine user dict
 #: with exactly one of these keys encodes via __dict__ instead, so
@@ -134,21 +192,34 @@ HISTORY_WRITE_CHUNK = 16_384
 def write_history_jsonl(path: str, ops: Iterable[Op]) -> None:
     """One op per JSON line — THE history file format (used by Store
     and by per-key artifact writers). Large histories write in
-    HISTORY_WRITE_CHUNK batches."""
-    with open(path, "w") as f:
-        buf = []
-        for op in ops:
-            buf.append(json.dumps(op_to_json(op), default=str))
-            if len(buf) >= HISTORY_WRITE_CHUNK:
+    HISTORY_WRITE_CHUNK batches, into a temp file that atomically
+    renames over the destination (a crashed writer never leaves a
+    half-history where a later `analyze` would find it)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            buf = []
+            for op in ops:
+                buf.append(json.dumps(op_to_json(op), default=str))
+                if len(buf) >= HISTORY_WRITE_CHUNK:
+                    f.write("\n".join(buf) + "\n")
+                    buf.clear()
+            if buf:
                 f.write("\n".join(buf) + "\n")
-                buf.clear()
-        if buf:
-            f.write("\n".join(buf) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(os.path.dirname(path))
 
 
 def write_results_json(path: str, results: Any) -> None:
-    with open(path, "w") as f:
-        json.dump(_encode_value(results), f, indent=2, default=str)
+    atomic_write_json(path, results)
 
 
 class Store:
@@ -179,12 +250,23 @@ class Store:
 
     @staticmethod
     def _symlink(link: str, target: str) -> None:
+        """Atomic swap: build a temp symlink next to `link` and rename
+        it into place — a reader (or a crash) never observes a window
+        where `latest`/`current` is missing or dangling."""
+        tmp = f"{link}.tmp.{os.getpid()}"
         try:
-            if os.path.islink(link):
-                os.unlink(link)
-            os.symlink(target, link)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            os.symlink(target, tmp)
+            os.replace(tmp, link)
+            _fsync_dir(os.path.dirname(link))
         except OSError:  # filesystems without symlink support
-            pass
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
     # -- two-phase save (store.clj:367-392) -------------------------------
 
@@ -195,8 +277,7 @@ class Store:
             k: v for k, v in test.items()
             if k not in STRIP_KEYS and not k.startswith("_")
         }
-        with open(os.path.join(d, "test.json"), "w") as f:
-            json.dump(_encode_value(clean), f, indent=2, default=str)
+        atomic_write_json(os.path.join(d, "test.json"), clean)
         history: Optional[History] = test.get("history")
         if history is not None:
             write_history_jsonl(

@@ -1,0 +1,499 @@
+"""Durable analysis plane tests (checker/checkpoint.py + the
+checkpointed segmented driver in wgl_bitset.py).
+
+The contract under test: a checkpointed check is a plain segmented
+check plus a durable trail — identical verdicts always, strictly fewer
+launches after a crash, zero launches on a verdict replay, and NEVER a
+wrong verdict from a stale/tampered/foreign checkpoint (those reject
+to a cold run). Fast in-process cases run in tier-1 via Pallas
+interpret mode; the subprocess SIGKILL soak (a real `analyze --resume`
+killed mid-check) is marked slow + durability.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checker import wgl_bitset as bs
+from jepsen_tpu.checker.checkpoint import (
+    CHECKPOINT_FILE,
+    CheckpointSink,
+    checkpoint_stats,
+    reset_checkpoint_stats,
+    steps_content_hash,
+)
+from jepsen_tpu.checker.events import events_to_steps, history_to_events
+from jepsen_tpu.checker.linearizable import (
+    LinearizableChecker,
+    check_events_bucketed,
+)
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.ops import invoke_op, ok_op
+from jepsen_tpu.store import Store
+
+pytestmark = pytest.mark.durability
+
+
+@pytest.fixture
+def small_w(monkeypatch):
+    """Prepend narrow buckets so the burst recipe segments at W4/W5
+    instead of W12/W13 — same planner, same per-segment driver, same
+    frontier reshape across a bucket boundary, but the first-trace
+    cost in tier-1 drops ~6x. The real W12/W13 signatures still run
+    in the slow tests and the subprocess soaks."""
+    monkeypatch.setattr(bs, "W_BUCKETS", (4, 5) + bs.W_BUCKETS)
+
+
+def burst_history(rounds=2, pairs=40, bad_tail=False, nburst=13):
+    """Alternating narrow/wide phases so min_len=1 plans multiple
+    segments with different W buckets: each round is `pairs`
+    sequential write pairs on process 0 (window 1) followed by an
+    `nburst`-process concurrent write burst (window `nburst`).
+    bad_tail appends a read of a never-written value — definitely
+    invalid."""
+    ops = []
+    for _ in range(rounds):
+        for i in range(pairs):
+            ops.append(invoke_op(0, "write", i % 3))
+            ops.append(ok_op(0, "write", i % 3))
+        for p in range(nburst):
+            ops.append(invoke_op(p, "write", p % 3))
+        for p in range(nburst):
+            ops.append(ok_op(p, "write", p % 3))
+    if bad_tail:
+        ops.append(invoke_op(0, "read"))
+        ops.append(ok_op(0, "read", 7))
+    return History(ops)
+
+
+def _steps(h):
+    ev = history_to_events(h, model="cas-register")
+    return events_to_steps(ev, W=ev.window)
+
+
+def _run(steps, sink):
+    return bs.check_steps_bitset_segmented(
+        steps, model="cas-register", S=8, interpret=True,
+        checkpoint=sink,
+    )
+
+
+class Die(Exception):
+    """In-process crash nemesis: raised from the after_save hook to
+    simulate a SIGKILL at a chosen durable boundary."""
+
+
+def _die_after(n):
+    def hook(sink, st):
+        if st.get("verdict") is None and st["segments_done"] >= n:
+            raise Die()
+    return hook
+
+
+def test_burst_history_plans_multiple_segments():
+    steps = _steps(burst_history())
+    segs = bs.plan_segments(steps, min_len=1)
+    assert len(segs) >= 3
+    assert len({W for _, _, W in segs}) >= 2  # narrow AND wide phases
+
+
+def test_cold_run_verdicts_and_replays_with_zero_launches(
+    tmp_path, small_w
+):
+    # the plain-chain vs checkpointed differential is the slow
+    # test_check_events_bucketed_reports_checkpoint_block; this keeps
+    # tier-1 to the cheap per-segment kernel signatures only
+    h = burst_history(rounds=1, nburst=5)
+    reset_checkpoint_stats()
+    bs.reset_launch_stats()
+    sink = CheckpointSink(str(tmp_path), seg_min_len=1)
+    cold = _run(_steps(h), sink)
+    assert cold == (True, False, -1)
+    assert sink.resumed_from == 0 and not sink.replayed
+    assert os.path.exists(os.path.join(str(tmp_path), CHECKPOINT_FILE))
+    # second run against the finished checkpoint: verdict replay,
+    # zero launches
+    bs.reset_launch_stats()
+    sink2 = CheckpointSink(str(tmp_path), seg_min_len=1)
+    assert _run(_steps(h), sink2) == cold
+    assert sink2.replayed
+    assert bs.LAUNCH_STATS["launches"] == 0
+    assert checkpoint_stats()["replays"] == 1
+
+
+def test_kill_resume_runs_only_unverified_segments(tmp_path, small_w):
+    h = burst_history(nburst=5)
+    steps = _steps(h)
+    segs = bs.plan_segments(steps, min_len=1)
+    reset_checkpoint_stats()
+    bs.reset_launch_stats()
+    sink = CheckpointSink(
+        str(tmp_path), seg_min_len=1, after_save=_die_after(2)
+    )
+    with pytest.raises(Die):
+        _run(steps, sink)
+    killed_launches = bs.LAUNCH_STATS["launches"]
+    # fresh process: fresh steps object, fresh sink, same dir
+    bs.reset_launch_stats()
+    sink2 = CheckpointSink(str(tmp_path), seg_min_len=1)
+    v = _run(_steps(h), sink2)
+    assert sink2.resumed_from == 2
+    assert bs.LAUNCH_STATS["launches"] == len(segs) - 2
+    assert bs.LAUNCH_STATS["launches"] < len(segs) <= (
+        killed_launches + bs.LAUNCH_STATS["launches"]
+    )
+    st = checkpoint_stats()
+    assert st["resumes"] == 1 and st["resumed_segments"] == 2
+    # cold reference in a fresh dir: identical verdict
+    bs.reset_launch_stats()
+    cold = _run(
+        _steps(h),
+        CheckpointSink(str(tmp_path / "cold"), seg_min_len=1),
+    )
+    assert v == cold
+    assert bs.LAUNCH_STATS["launches"] == len(segs)
+
+
+def test_tampered_checkpoint_rejects_to_cold_run(tmp_path, small_w):
+    h = burst_history(nburst=5)
+    steps = _steps(h)
+    segs = bs.plan_segments(steps, min_len=1)
+    sink = CheckpointSink(
+        str(tmp_path), seg_min_len=1, after_save=_die_after(2)
+    )
+    with pytest.raises(Die):
+        _run(steps, sink)
+    # edit a field WITHOUT recomputing payload_sha: integrity check
+    # must refuse it
+    p = os.path.join(str(tmp_path), CHECKPOINT_FILE)
+    st = json.load(open(p))
+    st["segments_done"] = 1
+    json.dump(st, open(p, "w"))
+    reset_checkpoint_stats()
+    bs.reset_launch_stats()
+    sink2 = CheckpointSink(str(tmp_path), seg_min_len=1)
+    v = _run(_steps(h), sink2)
+    assert sink2.rejected
+    assert checkpoint_stats()["rejected"] == 1
+    assert bs.LAUNCH_STATS["launches"] == len(segs)  # full cold run
+    assert v == (True, False, -1)
+
+
+def test_torn_checkpoint_file_rejects(tmp_path, small_w):
+    h = burst_history(nburst=5)
+    sink = CheckpointSink(
+        str(tmp_path), seg_min_len=1, after_save=_die_after(2)
+    )
+    with pytest.raises(Die):
+        _run(_steps(h), sink)
+    p = os.path.join(str(tmp_path), CHECKPOINT_FILE)
+    data = open(p).read()
+    open(p, "w").write(data[: len(data) // 2])  # simulated torn write
+    sink2 = CheckpointSink(str(tmp_path), seg_min_len=1)
+    assert _run(_steps(h), sink2) == (True, False, -1)
+    assert sink2.rejected
+
+
+def test_foreign_history_checkpoint_rejected_by_content_hash(
+    tmp_path, small_w
+):
+    a = burst_history(rounds=3, nburst=5)
+    b = burst_history(rounds=4, nburst=5)
+    sink = CheckpointSink(str(tmp_path), seg_min_len=1)
+    _run(_steps(a), sink)
+    # same path, different history: hash mismatch, cold run, correct
+    # verdict for B
+    sink2 = CheckpointSink(str(tmp_path), seg_min_len=1)
+    assert _run(_steps(b), sink2) == (True, False, -1)
+    assert sink2.rejected and not sink2.replayed
+
+
+def test_content_hash_binds_steps_model_and_plan():
+    h = burst_history(rounds=2)
+    steps = _steps(h)
+    segs = bs.plan_segments(steps, min_len=1)
+    base = steps_content_hash(steps, "cas-register", 8, segs)
+    assert steps_content_hash(steps, "register", 8, segs) != base
+    assert steps_content_hash(steps, "cas-register", 16, segs) != base
+    assert steps_content_hash(
+        steps, "cas-register", 8, segs[:-1]
+    ) != base
+    other = _steps(burst_history(rounds=3))
+    osegs = bs.plan_segments(other, min_len=1)
+    assert steps_content_hash(other, "cas-register", 8, osegs) != base
+
+
+@pytest.mark.slow
+def test_escalation_invalidates_and_exact_resume_is_sound(tmp_path):
+    """A fast-tier death voids every fast checkpoint (restart-from-
+    segment-0 semantics); a kill during the exact pass resumes ON the
+    exact tier and reaches the same death verdict as a cold run."""
+    h = burst_history(bad_tail=True)
+    steps = _steps(h)
+    reset_checkpoint_stats()
+    bs.reset_launch_stats()
+    cold = _run(
+        steps, CheckpointSink(str(tmp_path / "cold"), seg_min_len=1)
+    )
+    assert cold[0] is False and cold[2] >= 0
+    assert bs.LAUNCH_STATS["escalations"] == 1
+    assert checkpoint_stats()["invalidations"] == 1
+    death_fr = np.array(steps._death_frontier)
+
+    # kill mid-exact-pass: die at the first durable boundary recorded
+    # with exact=True
+    def die_on_exact(sink, st):
+        if st.get("verdict") is None and st.get("exact") and (
+            st["segments_done"] >= 1
+        ):
+            raise Die()
+
+    sink = CheckpointSink(
+        str(tmp_path), seg_min_len=1, after_save=die_on_exact
+    )
+    with pytest.raises(Die):
+        _run(_steps(h), sink)
+    bs.reset_launch_stats()
+    steps2 = _steps(h)
+    sink2 = CheckpointSink(str(tmp_path), seg_min_len=1)
+    v = _run(steps2, sink2)
+    assert v == cold
+    assert sink2.resumed_from >= 1
+    # the resumed process re-enters the exact tier directly: no second
+    # escalation
+    assert bs.LAUNCH_STATS["escalations"] == 0
+    assert np.array_equal(np.array(steps2._death_frontier), death_fr)
+    # replay of a death verdict restores the death frontier too
+    steps3 = _steps(h)
+    sink3 = CheckpointSink(str(tmp_path), seg_min_len=1)
+    assert _run(steps3, sink3) == cold
+    assert sink3.replayed
+    assert np.array_equal(np.array(steps3._death_frontier), death_fr)
+
+
+def test_record_every_n_skips_intermediate_saves(tmp_path, small_w):
+    h = burst_history(nburst=5)
+    steps = _steps(h)
+    segs = bs.plan_segments(steps, min_len=1)
+    reset_checkpoint_stats()
+    sink = CheckpointSink(str(tmp_path), seg_min_len=1, every=3)
+    _run(steps, sink)
+    # every=3 boundaries + the finish() verdict save
+    assert checkpoint_stats()["saves"] == len(segs) // 3 + 1
+
+
+def test_checkpoint_saves_are_atomic_and_costed(tmp_path, small_w):
+    h = burst_history(rounds=2, nburst=5)
+    reset_checkpoint_stats()
+    sink = CheckpointSink(str(tmp_path), seg_min_len=1)
+    _run(_steps(h), sink)
+    # no tmp litter, and the durable file is valid self-hashed JSON
+    assert [
+        f for f in os.listdir(str(tmp_path)) if ".tmp" in f
+    ] == []
+    st = json.load(open(os.path.join(str(tmp_path), CHECKPOINT_FILE)))
+    assert st["payload_sha"]
+    stats = checkpoint_stats()
+    assert stats["saves"] >= 2 and stats["overhead_s"] > 0
+
+
+@pytest.mark.slow
+def test_check_events_bucketed_reports_checkpoint_block(tmp_path):
+    h = burst_history(rounds=2)
+    ev = history_to_events(h, model="cas-register")
+    plain = check_events_bucketed(
+        ev, model="cas-register", interpret=True, race=False
+    )
+    sink = CheckpointSink(str(tmp_path), seg_min_len=1)
+    out = check_events_bucketed(
+        ev, model="cas-register", interpret=True, checkpoint=sink
+    )
+    assert out["valid?"] == plain["valid?"]
+    assert out["method"] == "tpu-wgl-bitset"
+    assert out["checkpoint"]["segments_total"] >= 2
+    assert out["checkpoint"]["resumed_from_segment"] == 0
+
+
+@pytest.mark.slow
+def test_checker_check_threads_checkpoint_through(tmp_path):
+    h = burst_history(rounds=2, bad_tail=True)
+    checker = LinearizableChecker(interpret=True)
+    sink = CheckpointSink(str(tmp_path), seg_min_len=1)
+    out = checker.check({}, h, checkpoint=sink)
+    assert out["valid?"] is False
+    assert out["checkpoint"]["segments_total"] >= 2
+    assert out["failed_op_index"] >= 0
+    assert "failure" in out
+    # resumed re-check replays the stored verdict, failure report
+    # included
+    sink2 = CheckpointSink(str(tmp_path), seg_min_len=1)
+    out2 = LinearizableChecker(interpret=True).check(
+        {}, burst_history(rounds=2, bad_tail=True), checkpoint=sink2
+    )
+    assert out2["valid?"] is False
+    assert out2["failed_op_index"] == out["failed_op_index"]
+    assert out2["checkpoint"]["replayed_verdict"]
+    assert out2["failure"]["failed_op"] == out["failure"]["failed_op"]
+
+
+def test_checker_check_valid_checkpoint_wiring(tmp_path, small_w):
+    h = burst_history(rounds=1, nburst=5)
+    out = LinearizableChecker(interpret=True).check(
+        {}, h, checkpoint=CheckpointSink(str(tmp_path), seg_min_len=1)
+    )
+    assert out["valid?"] is True
+    assert out["checkpoint"]["segments_total"] >= 2
+    out2 = LinearizableChecker(interpret=True).check(
+        {}, burst_history(rounds=1, nburst=5),
+        checkpoint=CheckpointSink(str(tmp_path), seg_min_len=1),
+    )
+    assert out2["valid?"] is True
+    assert out2["checkpoint"]["replayed_verdict"]
+
+
+def test_dispatch_plane_routes_checkpointed_checks(tmp_path, small_w):
+    from jepsen_tpu.checker.dispatch import (
+        DispatchPlane,
+        dispatch_stats,
+        reset_dispatch_stats,
+    )
+
+    h = burst_history(rounds=1, nburst=5)
+    ev = history_to_events(h, model="cas-register")
+    reset_dispatch_stats()
+    reset_checkpoint_stats()
+    with DispatchPlane(interpret=True) as plane:
+        fut = plane.submit(
+            ev, model="cas-register",
+            checkpoint=CheckpointSink(str(tmp_path), seg_min_len=1),
+        )
+        out = fut.result()
+    assert out["valid?"] is True
+    assert out["checkpoint"]["segments_total"] >= 2
+    st = dispatch_stats()
+    assert st["checkpoint"]["saves"] >= 2
+
+
+# -- subprocess SIGKILL soak: the real `analyze --resume` contract ----
+
+
+def _store_run(root, rounds=12, bad_tail=False):
+    st = Store(root)
+    test = {
+        "name": "ckpt-soak",
+        "workload": "register",
+        "history": burst_history(rounds=rounds, bad_tail=bad_tail),
+    }
+    d = st.make_run_dir(test)
+    st.save_1(test)
+    return st, d
+
+
+def _analyze(run_dir, root, resume=True, **popen_kw):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        JEPSEN_TPU_INTERPRET="1",
+        JEPSEN_TPU_SEG_MIN_LEN="1",
+    )
+    cmd = [
+        sys.executable, "-m", "jepsen_tpu.cli", "analyze", run_dir,
+        "--workload", "register", "--store", root,
+    ]
+    if resume:
+        cmd.append("--resume")
+    return subprocess.Popen(
+        cmd, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL, **popen_kw,
+    )
+
+
+def _verdict_fields(res):
+    return {
+        k: res.get(k)
+        for k in ("valid?", "failed_op_index", "failure")
+    }
+
+
+@pytest.mark.slow
+def test_sigkill_analyze_resume_differential(tmp_path):
+    """Kill a real analyze subprocess mid-check (SIGKILL, no cleanup),
+    re-run `analyze --resume`, and require: byte-identical verdict to
+    an uninterrupted cold run, strictly fewer launches in the resumed
+    process, and checkpoint overhead within the <5%-of-wall budget."""
+    root = str(tmp_path)
+    store, d_kill = _store_run(root)
+    # cold reference run dir with the identical history
+    store2, d_cold = _store_run(root)
+
+    proc = _analyze(d_kill, root)
+    ckpt = os.path.join(d_kill, CHECKPOINT_FILE)
+    deadline = time.time() + 420
+    seen = 0
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            break
+        try:
+            seen = json.load(open(ckpt)).get("segments_done", 0)
+        except (OSError, ValueError):
+            seen = 0
+        if seen >= 3:
+            os.kill(proc.pid, signal.SIGKILL)
+            break
+        time.sleep(0.05)
+    proc.wait(timeout=60)
+    assert seen >= 3, "subprocess finished before the kill landed"
+    assert store.load_results(d_kill) is None  # died mid-check
+
+    # resumed run completes with strictly fewer launches than cold
+    assert _analyze(d_kill, root).wait(timeout=540) == 0
+    assert _analyze(d_cold, root).wait(timeout=540) == 0
+    res_k = store.load_results(d_kill)
+    res_c = store2.load_results(d_cold)
+    assert _verdict_fields(res_k) == _verdict_fields(res_c)
+    launches_k = res_k["engine_stats"]["launch"]["launches"]
+    launches_c = res_c["engine_stats"]["launch"]["launches"]
+    assert 0 < launches_k < launches_c
+    ck = res_k["engine_stats"]["checkpoint"]
+    assert ck["resumes"] == 1 and ck["resumed_segments"] >= 3
+    # overhead budget on the uninterrupted run (ISSUE acceptance: the
+    # durable trail costs <5% of check wall)
+    cc = res_c["engine_stats"]["checkpoint"]
+    assert cc["overhead_s"] < 0.05 * res_c["wall_s"]
+
+
+@pytest.mark.slow
+def test_sigkill_tampered_checkpoint_cold_reruns(tmp_path):
+    """A tampered checkpoint after a kill is rejected: the re-run is
+    cold (full launch count), never a wrong verdict."""
+    root = str(tmp_path)
+    store, d = _store_run(root, rounds=6)
+    proc = _analyze(d, root)
+    ckpt = os.path.join(d, CHECKPOINT_FILE)
+    deadline = time.time() + 420
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            break
+        try:
+            if json.load(open(ckpt)).get("segments_done", 0) >= 2:
+                os.kill(proc.pid, signal.SIGKILL)
+                break
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    proc.wait(timeout=60)
+    if os.path.exists(ckpt):
+        st = json.load(open(ckpt))
+        st["segments_done"] = 1  # no payload_sha recompute
+        json.dump(st, open(ckpt, "w"))
+    assert _analyze(d, root).wait(timeout=540) == 0
+    res = store.load_results(d)
+    assert res["valid?"] is True
+    ck = res["engine_stats"]["checkpoint"]
+    assert ck["rejected"] >= 1 and ck["resumes"] == 0

@@ -276,3 +276,61 @@ def test_events_to_steps_vectorized_matches_loop():
                 getattr(a, field)[a.occ], getattr(b, field)[b.occ]
             ), f"seed {seed} field {field}"
         assert a.init_state == b.init_state and a.W == b.W
+
+
+# -- pathological inputs: what pairs()/complete() silently tolerate ----
+# These pin the EXACT behavior the history sentry (history/sentry.py)
+# repairs against: its quarantine/reindex decisions route through the
+# same pairing definition, so if any of these change, sentry.py must
+# change with them (test_sentry.py proves the differential).
+
+
+def test_pairs_ignores_completion_without_invocation():
+    h = History([
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        ok_op(3, "read", 9),  # no invoke on process 3, ever
+    ])
+    p = h.pairs()
+    assert p[0] == 1 and p[1] == 0
+    assert 2 not in p  # silently absent from pairing, not an error
+    assert h.invocation(h[2]) is None
+
+
+def test_pairs_clobber_on_duplicate_indices():
+    """pairs() keys by op.index: two ops sharing an index collapse to
+    one entry — the corruption the sentry's dense reindex repairs."""
+    ops = [
+        invoke_op(0, "write", 1).with_(index=0),
+        ok_op(0, "write", 1).with_(index=0),  # duplicate index
+    ]
+    h = History(ops, indexed=True)
+    p = h.pairs()
+    # one key total: the invoke's entry was clobbered by its own
+    # completion landing on the same index
+    assert set(p.keys()) == {0}
+
+
+def test_pairs_ignores_double_completion():
+    h = History([
+        invoke_op(1, "read"),
+        ok_op(1, "read", 1),
+        ok_op(1, "read", 2),  # second completion of the same invoke
+    ])
+    p = h.pairs()
+    assert p[0] == 1 and p[1] == 0
+    assert 2 not in p  # the double is dropped from pairing
+
+
+def test_complete_survives_orphans_and_doubles():
+    """complete() copies :ok values back to invocations; pathological
+    completions must neither crash it nor corrupt the real pair."""
+    h = History([
+        invoke_op(0, "write", 7),
+        ok_op(3, "read", 9),  # orphan
+        ok_op(0, "write", 7),
+        ok_op(0, "write", 8),  # double (ignored)
+    ]).complete()
+    assert h[0].value == 7
+    p = h.pairs()
+    assert p[0] == 2 and 3 not in p

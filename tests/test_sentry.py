@@ -1,0 +1,296 @@
+"""History sentry tests (history/sentry.py).
+
+One test family per corruption class in CORRUPTION_CLASSES: build the
+corrupt history, prove _scan detects it, prove strict mode raises
+naming it, prove the repaired history checks IDENTICALLY to the
+hand-cleaned equivalent (the differential that makes repairs safe to
+trust). Plus the zero-copy clean path, the per-process (not global)
+time-monotonicity rule, and report attachment through
+LinearizableChecker.check / check_queue_by_value.
+"""
+
+import pytest
+
+from jepsen_tpu.checker.linearizable import (
+    LinearizableChecker,
+    check_queue_by_value,
+)
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.ops import (
+    fail_op,
+    info_op,
+    invoke_op,
+    ok_op,
+)
+from jepsen_tpu.history.sentry import (
+    CORRUPTION_CLASSES,
+    HistorySentryError,
+    validate_history,
+)
+
+
+def _clean_ops(t0=0.0):
+    """A well-formed concurrent register history: the base every
+    corruption case mutates."""
+    ops = [
+        invoke_op(0, "write", 1),
+        invoke_op(1, "read"),
+        ok_op(0, "write", 1),
+        ok_op(1, "read", 1),
+        invoke_op(0, "cas", [1, 2]),
+        ok_op(0, "cas", [1, 2]),
+        invoke_op(1, "read"),
+        ok_op(1, "read", 2),
+    ]
+    return [o.with_(time=t0 + i) for i, o in enumerate(ops)]
+
+
+def _verdict(h):
+    out = LinearizableChecker(use_tpu=False, sentry=False).check({}, h)
+    return (out["valid?"], out.get("failed_op_index"))
+
+
+# -- corruption builders: (corrupt ops, hand-cleaned ops) -------------
+
+
+def _case_duplicate_index():
+    ops = History(_clean_ops()).ops
+    bad = list(ops)
+    bad[3] = bad[3].with_(index=bad[2].index)  # two ops share an index
+    return bad, ops
+
+
+def _case_missing_index():
+    ops = History(_clean_ops()).ops
+    bad = list(ops)
+    bad[5] = bad[5].with_(index=-1)
+    return bad, ops
+
+
+def _case_orphan_completion():
+    ops = _clean_ops()
+    bad = list(ops)
+    bad.insert(4, ok_op(7, "read", 9).with_(time=3.5))  # never invoked
+    return bad, ops
+
+
+def _case_double_completion():
+    ops = _clean_ops()
+    bad = list(ops)
+    bad.insert(4, ok_op(1, "read", 1).with_(time=3.5))  # second :ok
+    return bad, ops
+
+
+def _case_inversion():
+    ops = _clean_ops()
+    bad = list(ops)
+    bad[4], bad[5] = bad[5], bad[4]  # completion before its invoke
+    return bad, ops
+
+
+def _case_unpaired_info():
+    ops = _clean_ops()
+    bad = list(ops)
+    bad.append(info_op(3, "write", 5).with_(time=9.0))  # no open invoke
+    return bad, ops
+
+
+def _case_non_monotone_time():
+    ops = _clean_ops()
+    bad = list(ops)
+    bad[5] = bad[5].with_(time=0.5)  # process 0's clock runs backwards
+    # hand-clean: the repair clamps to the process's running max
+    good = list(ops)
+    good[5] = good[5].with_(time=good[4].time)
+    return bad, good
+
+
+def _case_nemesis_interleaved():
+    ops = _clean_ops()
+    bad = list(ops)
+    bad.insert(0, invoke_op("nemesis", "start").with_(time=-1.0))
+    bad.insert(1, ok_op("nemesis", "start").with_(time=-0.5))
+    # a nemesis f riding a client-like integer process
+    bad.insert(4, invoke_op(5, "start").with_(time=2.5))
+    good = list(ops)
+    good.insert(0, invoke_op("nemesis", "start").with_(time=-1.0))
+    good.insert(1, ok_op("nemesis", "start").with_(time=-0.5))
+    return bad, good
+
+
+_CASES = {
+    "duplicate_index": _case_duplicate_index,
+    "missing_index": _case_missing_index,
+    "orphan_completion": _case_orphan_completion,
+    "double_completion": _case_double_completion,
+    "inversion": _case_inversion,
+    "unpaired_info": _case_unpaired_info,
+    "non_monotone_time": _case_non_monotone_time,
+    "nemesis_interleaved": _case_nemesis_interleaved,
+}
+
+
+def test_every_corruption_class_has_a_case():
+    assert set(_CASES) == set(CORRUPTION_CLASSES)
+
+
+@pytest.mark.durability
+@pytest.mark.parametrize("cls", CORRUPTION_CLASSES)
+def test_detects_and_reports(cls):
+    bad, _ = _CASES[cls]()
+    fixed, report = validate_history(History(bad, indexed=True))
+    assert not report["clean"]
+    assert cls in report["detected"], report
+    assert cls in report["repairs"], report
+    assert "residue" not in report  # repair converged
+
+
+@pytest.mark.durability
+@pytest.mark.parametrize("cls", CORRUPTION_CLASSES)
+def test_strict_mode_raises_naming_the_class(cls):
+    bad, _ = _CASES[cls]()
+    with pytest.raises(HistorySentryError) as ei:
+        validate_history(History(bad, indexed=True), strict=True)
+    assert cls in ei.value.classes
+    assert cls in str(ei.value)
+
+
+@pytest.mark.durability
+@pytest.mark.parametrize("cls", CORRUPTION_CLASSES)
+def test_repaired_checks_like_hand_cleaned(cls):
+    """The differential that justifies repairing at all: the repaired
+    history and the hand-cleaned equivalent get the same verdict from
+    the same checker."""
+    bad, good = _CASES[cls]()
+    fixed, report = validate_history(History(bad, indexed=True))
+    assert not report["clean"]
+    assert _verdict(fixed) == _verdict(History(good))
+
+
+def test_clean_history_is_zero_copy():
+    h = History(_clean_ops())
+    out, report = validate_history(h)
+    assert out is h  # the ORIGINAL object: memoized streams survive
+    assert report == {"clean": True, "repairs": {}, "quarantined": []}
+
+
+def test_cross_process_time_jitter_is_healthy():
+    """GLOBAL monotonicity must NOT be required: the runtime stamps an
+    op's time before taking the journal lock, so healthy concurrent
+    runs interleave stamps slightly out of global order."""
+    ops = [
+        invoke_op(0, "write", 1).with_(time=1.0),
+        invoke_op(1, "read").with_(time=0.9),  # global regression: OK
+        ok_op(0, "write", 1).with_(time=2.0),
+        ok_op(1, "read", 1).with_(time=1.5),
+    ]
+    out, report = validate_history(History(ops))
+    assert report["clean"]
+
+
+def test_quarantine_lands_in_report_not_silence():
+    bad, _ = _CASES["orphan_completion"]()
+    fixed, report = validate_history(History(bad, indexed=True))
+    assert len(report["quarantined"]) == 1
+    assert report["n_out"] == report["n_in"] - 1
+
+
+def test_reindex_preserves_original_indices():
+    bad, _ = _CASES["duplicate_index"]()
+    fixed, report = validate_history(History(bad, indexed=True))
+    assert [o.index for o in fixed.ops] == list(range(len(fixed)))
+    assert any(
+        o.extra.get("orig_index") is not None for o in fixed.ops
+    )
+
+
+def test_crashed_invoke_stays_open_without_complaint():
+    """A crashed op (:info completion present, invoke open forever) is
+    crash SEMANTICS, not corruption — the sentry must pass it."""
+    ops = [
+        invoke_op(0, "write", 1).with_(time=0.0),
+        info_op(0, "write", 1).with_(time=1.0),  # paired crash
+        invoke_op(1, "write", 2).with_(time=2.0),
+        # process 1's invoke never completes: also fine
+    ]
+    out, report = validate_history(History(ops))
+    assert report["clean"]
+
+
+def test_failed_ops_are_not_corruption():
+    ops = [
+        invoke_op(0, "cas", [9, 1]).with_(time=0.0),
+        fail_op(0, "cas", [9, 1]).with_(time=1.0),
+    ]
+    out, report = validate_history(History(ops))
+    assert report["clean"]
+
+
+def test_compound_corruption_repairs_in_one_pass():
+    """Several classes at once (the crashed-control-plane shape): the
+    single repair pass converges with no residue."""
+    bad = list(History(_clean_ops()).ops)  # assigns dense indices
+    bad[4], bad[5] = bad[5], bad[4]  # inversion
+    bad.append(
+        ok_op(7, "read", 9).with_(index=len(bad), time=9.0)
+    )  # orphan
+    bad[2] = bad[2].with_(index=bad[1].index)  # duplicate index
+    fixed, report = validate_history(History(bad, indexed=True))
+    assert not report["clean"]
+    assert "residue" not in report
+    for cls in ("inversion", "orphan_completion", "duplicate_index"):
+        assert cls in report["detected"]
+    # and the result still checks
+    assert _verdict(fixed)[0] is True
+
+
+@pytest.mark.durability
+def test_checker_attaches_history_report():
+    bad, _ = _CASES["orphan_completion"]()
+    out = LinearizableChecker(use_tpu=False).check({}, History(bad))
+    assert out["history_report"]["clean"] is False
+    assert "orphan_completion" in out["history_report"]["detected"]
+    # verdict is the repaired history's, not an exception
+    assert out["valid?"] is True
+
+
+def test_checker_clean_history_attaches_nothing():
+    out = LinearizableChecker(use_tpu=False).check(
+        {}, History(_clean_ops())
+    )
+    assert "history_report" not in out
+
+
+@pytest.mark.durability
+def test_checker_strict_mode_raises():
+    bad, _ = _CASES["double_completion"]()
+    checker = LinearizableChecker(use_tpu=False, strict_history=True)
+    with pytest.raises(HistorySentryError):
+        checker.check({}, History(bad))
+
+
+def test_sentry_off_bypasses_validation():
+    bad, _ = _CASES["orphan_completion"]()
+    out = LinearizableChecker(use_tpu=False, sentry=False).check(
+        {}, History(bad)
+    )
+    assert "history_report" not in out
+
+
+@pytest.mark.durability
+def test_queue_checker_validates_too():
+    ops = [
+        invoke_op(0, "enqueue", 1).with_(time=0.0),
+        ok_op(0, "enqueue", 1).with_(time=1.0),
+        invoke_op(1, "dequeue").with_(time=2.0),
+        ok_op(1, "dequeue", 1).with_(time=3.0),
+        ok_op(9, "dequeue", 4).with_(time=4.0),  # orphan completion
+    ]
+    out = check_queue_by_value(History(ops), "unordered-queue")
+    assert out is not None
+    assert out["valid?"] is True
+    assert out["history_report"]["clean"] is False
+    with pytest.raises(HistorySentryError):
+        check_queue_by_value(
+            History(ops), "unordered-queue", strict=True
+        )

@@ -315,3 +315,159 @@ def test_failure_svg_rendering(tmp_path):
     path = write_failure_svg(failure, str(tmp_path), failed_op_index=42)
     assert path.endswith("linear.svg")
     assert "<svg" in open(path).read()
+
+
+# -- durable-analysis satellites: atomic writes, exit-code contract,
+# -- clean engine slate ------------------------------------------------
+
+
+def test_atomic_write_crash_leaves_old_or_new_never_torn(
+    tmp_path, monkeypatch
+):
+    """The two-phase discipline's regression: a crash at ANY point of
+    a save leaves either the complete old state or the complete new
+    state on disk — never a truncated hybrid."""
+    from jepsen_tpu import store as storelib
+
+    p = str(tmp_path / "state.json")
+    storelib.atomic_write_json(p, {"gen": 1, "payload": "x" * 4096})
+
+    # crash INSIDE the rename: the tmp file is written but never
+    # becomes the target
+    real_replace = os.replace
+
+    def exploding_replace(src, dst):
+        raise OSError("simulated crash mid-save")
+
+    monkeypatch.setattr(os, "replace", exploding_replace)
+    with pytest.raises(OSError):
+        storelib.atomic_write_json(
+            p, {"gen": 2, "payload": "y" * 4096}
+        )
+    monkeypatch.setattr(os, "replace", real_replace)
+    old = json.load(open(p))
+    assert old["gen"] == 1 and old["payload"] == "x" * 4096
+    # no tmp litter survives the failed save
+    assert [f for f in os.listdir(str(tmp_path)) if ".tmp" in f] == []
+
+    # the retried save lands the complete new state
+    storelib.atomic_write_json(p, {"gen": 2, "payload": "y" * 4096})
+    new = json.load(open(p))
+    assert new["gen"] == 2 and new["payload"] == "y" * 4096
+
+
+def test_store_symlink_swap_is_atomic(tmp_path):
+    """latest-pointer updates go through a tmp symlink + rename: the
+    link never dangles and always resolves to a complete run dir."""
+    st = Store(str(tmp_path))
+    dirs = []
+    for i in range(3):
+        h = History([
+            invoke_op(0, "write", i), ok_op(0, "write", i),
+        ])
+        test = {"name": "swap", "history": h,
+                "results": {"valid?": True}}
+        st.save_1(test)
+        st.save_2(test)
+        dirs.append(test["run_dir"])
+        latest = os.path.join(str(tmp_path), "swap", "latest")
+        assert os.path.islink(latest)
+        assert os.path.realpath(latest) == os.path.realpath(dirs[-1])
+        # no tmp symlink litter from the swap
+        parent = os.path.dirname(latest)
+        assert [f for f in os.listdir(parent) if ".tmp" in f] == []
+
+
+def test_cli_strict_history_exit_code_contract(tmp_path):
+    """Exit code 3 (hostile history) is its own verdict, distinct from
+    1 (invalid) and 2 (unknown): the history never reached a checker,
+    and the message says so."""
+    from jepsen_tpu.cli import EXIT_HOSTILE_HISTORY, _epitaph
+
+    store_root = str(tmp_path / "store")
+    st = Store(store_root)
+    h = History([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(0, "read"), ok_op(0, "read", 1),
+    ])
+    # a completion with no invocation, ever: sentry-hostile but
+    # checker-tolerated — strict mode must refuse it, default mode
+    # must repair and verdict it
+    h = History(list(h.ops) + [ok_op(9, "read", 5)])
+    test = {"name": "hostile", "history": h, "results": None}
+    st.save_1(test)
+
+    code = main([
+        "analyze", "hostile", "--workload", "register",
+        "--store", store_root, "--strict-history",
+    ])
+    assert code == EXIT_HOSTILE_HISTORY
+    assert code not in (EXIT_VALID, EXIT_INVALID)
+    # no verdict was issued: results.json stays absent
+    assert st.load_results(test["run_dir"]) is None
+    # the three failure epitaphs are pairwise distinct messages
+    msgs = {
+        _epitaph(c)
+        for c in (EXIT_INVALID, 2, EXIT_HOSTILE_HISTORY)
+    }
+    assert len(msgs) == 3
+
+    # without --strict-history the same run repairs + verdicts (and
+    # reports what it repaired)
+    code = main([
+        "analyze", "hostile", "--workload", "register",
+        "--store", store_root,
+    ])
+    assert code == EXIT_VALID
+    res = st.load_results(test["run_dir"])
+    assert res["valid?"] is True
+    assert res["history_report"]["clean"] is False
+
+
+def test_cli_commands_start_with_clean_engine_slate(tmp_path):
+    """cmd_test/cmd_analyze reset the resilience + stats planes at
+    entry: ledgers poisoned by a prior in-process run (or an embedding
+    harness) must not leak into this run's verdict or stats."""
+    from jepsen_tpu.checker import chaos
+    from jepsen_tpu.checker import wgl_bitset as bs
+    from jepsen_tpu.checker.checkpoint import (
+        CHECKPOINT_STATS,
+        checkpoint_stats,
+    )
+
+    store_root = str(tmp_path / "store")
+    st = Store(store_root)
+    h = History([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+    ])
+    test = {"name": "slate", "history": h, "results": None}
+    st.save_1(test)
+
+    # poison every ledger the reset owns
+    for _ in range(3):
+        chaos.note_device_failure("TPU_9", quarantine_after=3)
+    assert "TPU_9" in chaos.quarantined_devices()
+    with bs._launch_stats_lock:
+        bs.LAUNCH_STATS["launches"] = 999
+    CHECKPOINT_STATS["saves"] = 777
+
+    assert main([
+        "analyze", "slate", "--workload", "register",
+        "--store", store_root,
+    ]) == EXIT_VALID
+    assert "TPU_9" not in chaos.quarantined_devices()
+    res = st.load_results(test["run_dir"])
+    # the reported stats are THIS run's, not the poisoned residue
+    assert res["engine_stats"]["launch"]["launches"] < 999
+    assert res["engine_stats"]["checkpoint"]["saves"] < 777
+
+    # back-to-back: a second analyze starts clean again
+    assert main([
+        "analyze", "slate", "--workload", "register",
+        "--store", store_root,
+    ]) == EXIT_VALID
+    res2 = st.load_results(test["run_dir"])
+    assert (
+        res2["engine_stats"]["launch"]["launches"]
+        == res["engine_stats"]["launch"]["launches"]
+    )
