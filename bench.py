@@ -641,6 +641,114 @@ def bench_chaos_smoke() -> None:
     }))
 
 
+# -- checker-service delta (--service-delta) ---------------------------------
+
+
+def bench_service_delta() -> None:
+    """Warm-plane vs cold-process delta on etcd-1k: what the checker
+    daemon buys over one-shot `analyze` subprocesses.
+
+    - cold_process_wall_s: a FRESH `python -m jepsen_tpu.cli analyze`
+      subprocess per history — every check pays interpreter start,
+      jax import, trace/compile, and its own sync.
+    - warm_daemon_wall_s: the same histories served by one running
+      daemon (service.CheckerDaemon) through CheckerClient — process,
+      mesh, memo, and compile caches all warm; only the check itself
+      and a local HTTP round trip remain.
+
+    Emits one JSON line (metric service_delta). On a CPU host this is
+    a flow validation with honest CPU-labeled numbers, not a TPU
+    measurement.
+    """
+    import os
+    import subprocess
+    import tempfile
+    import threading
+
+    import jax
+
+    from jepsen_tpu.service.client import CheckerClient
+    from jepsen_tpu.service.server import CheckerDaemon
+    from jepsen_tpu.sim import gen_register_history
+    from jepsen_tpu.store import Store
+
+    on_cpu = jax.default_backend() == "cpu"
+    env = dict(os.environ, JAX_PLATFORMS=jax.default_backend())
+    if on_cpu:
+        env["JEPSEN_TPU_INTERPRET"] = "1"
+        os.environ["JEPSEN_TPU_INTERPRET"] = "1"
+    n_hist = _n(4, 2)
+    hists = [
+        gen_register_history(
+            random.Random(100 + seed), n_ops=_n(1000, 60), n_procs=5,
+            p_crash=0.01,
+        )
+        for seed in range(n_hist)
+    ]
+
+    root = tempfile.mkdtemp(prefix="bench-service-")
+    st = Store(root)
+    run_dirs = []
+    for i, h in enumerate(hists):
+        test = {"name": f"svc-delta-{i}", "history": h}
+        st.make_run_dir(test)
+        st.save_1(test)
+        run_dirs.append(test["run_dir"])
+
+    # cold: one fresh analyze process per history, timed end to end
+    cold_walls = []
+    for d in run_dirs:
+        t0 = time.perf_counter()
+        rc = subprocess.run(
+            [sys.executable, "-m", "jepsen_tpu.cli", "analyze", d,
+             "--workload", "register", "--store", root],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        ).returncode
+        cold_walls.append(time.perf_counter() - t0)
+        assert rc == 0, f"cold analyze failed (rc={rc}) for {d}"
+
+    # warm: one daemon, same histories over the wire; first check
+    # (not timed) pays the trace the daemon amortizes thereafter
+    daemon = CheckerDaemon(root=root, port=0, interpret=None)
+    thread = threading.Thread(
+        target=daemon.serve_forever, daemon=True
+    )
+    thread.start()
+    client = CheckerClient(port=daemon.port, timeout_s=600,
+                           tenant="bench")
+    try:
+        warm0 = client.check(hists[0], model="cas-register")
+        assert "valid?" in warm0
+        warm_walls = []
+        for h in hists:
+            t0 = time.perf_counter()
+            out = client.check(h, model="cas-register")
+            warm_walls.append(time.perf_counter() - t0)
+            assert "valid?" in out
+    finally:
+        daemon.admission.start_drain()
+        daemon.httpd.shutdown()
+        thread.join(timeout=10)
+        daemon.close()
+
+    cold = sum(cold_walls) / len(cold_walls)
+    warm = sum(warm_walls) / len(warm_walls)
+    print(json.dumps({
+        "metric": "service_delta",
+        "value": cold / warm if warm else None,
+        "unit": "x (cold-process / warm-daemon, etcd-1k)",
+        "backend": jax.default_backend(),
+        "n_histories": n_hist,
+        "n_ops": _n(1000, 60),
+        "cold_process_wall_s": round(cold, 3),
+        "warm_daemon_wall_s": round(warm, 4),
+        "cold_walls_s": [round(w, 3) for w in cold_walls],
+        "warm_walls_s": [round(w, 4) for w in warm_walls],
+        "smoke": SMOKE,
+    }))
+
+
 # -- reduction configs (3, 4, 5) ---------------------------------------------
 
 
@@ -962,6 +1070,10 @@ def main() -> None:
 
     if chaos_mode:
         bench_chaos_smoke()
+        return
+
+    if "--service-delta" in sys.argv:
+        bench_service_delta()
         return
 
     if "--profile" in sys.argv:

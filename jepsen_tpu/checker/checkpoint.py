@@ -162,6 +162,12 @@ class CheckpointSink:
     ):
         if os.path.isdir(path):
             path = os.path.join(path, CHECKPOINT_FILE)
+        parent = os.path.dirname(path)
+        if parent:
+            # Callers hand us deep, not-yet-existing paths (the service
+            # daemon keys sinks by tenant/check-id); the sink owns its
+            # directory so the first record() cannot fail on ENOENT.
+            os.makedirs(parent, exist_ok=True)
         self.path = path
         self.seg_min_len = seg_min_len
         self.every = max(int(every), 1)

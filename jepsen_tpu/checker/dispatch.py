@@ -81,6 +81,7 @@ import itertools
 import threading
 import time
 from collections import OrderedDict, deque
+from contextlib import contextmanager
 from typing import Any, List, Optional
 
 import jax
@@ -222,6 +223,47 @@ def dispatch_stats() -> dict:
     return out
 
 
+#: thread-local tenant attribution: the service daemon's handler
+#: threads enter tenant_context(name) so every submit() on that thread
+#: stamps its futures — checker entry points (check/check_async) need
+#: no tenant-aware API change.
+_TENANT_LOCAL = threading.local()
+
+
+@contextmanager
+def tenant_context(tenant: Optional[str]):
+    """Attribute every submit() on this thread to ``tenant`` (the
+    multi-tenant service's per-request scope). Nests; None clears."""
+    prev = getattr(_TENANT_LOCAL, "tenant", None)
+    _TENANT_LOCAL.tenant = tenant
+    try:
+        yield
+    finally:
+        _TENANT_LOCAL.tenant = prev
+
+
+def current_tenant() -> Optional[str]:
+    return getattr(_TENANT_LOCAL, "tenant", None)
+
+
+def _tenant_tags(futs) -> List[str]:
+    """chaos pseudo-labels for the tenants riding a launch — appended
+    to the guard's device-label list so (a) a chaos plan can target one
+    tenant's launches deterministically (ChaosFault(device="tenant:x"))
+    and (b) attributed failures count against the TENANT label in the
+    quarantine registry instead of ejecting a healthy chip: a tenant's
+    fault storm trips its own breaker (chaos.quarantined_tenants),
+    never the mesh."""
+    seen = []
+    for f in futs:
+        t = getattr(f, "tenant", None)
+        if t is not None:
+            lbl = chaos.TENANT_PREFIX + str(t)
+            if lbl not in seen:
+                seen.append(lbl)
+    return seen
+
+
 class CheckFuture:
     """Handle for one submitted check. ``result()`` drives the owning
     plane as needed (flushing un-launched buckets, collecting the
@@ -234,6 +276,7 @@ class CheckFuture:
         self.events = events
         self.model = model  # original model name (racer + fallbacks)
         self.checkpoint = None  # durable-analysis sink (submit(...))
+        self.tenant = current_tenant()  # multi-tenant attribution
         self.kind: Optional[str] = None
         self.kernel_model = model  # post packed-substitution
         self.steps = None
@@ -368,6 +411,13 @@ class DispatchPlane:
         self.quarantine_after = quarantine_after
         self.worker_join_s = worker_join_s
         self.mesh = resolve_mesh(mesh)
+        #: optional per-future fault attribution hook for multi-tenant
+        #: embedders (the service daemon's tenant ledger): called as
+        #: fault_observer(tenant, kind) with kind in
+        #: {"oracle_fallback", "plane_fault"} whenever a future resolves
+        #: through the degradation ladder's last rungs. Exceptions are
+        #: swallowed — observers must never wedge resolution.
+        self.fault_observer = None
         self._devices = (
             list(self.mesh.devices.flat)
             if self.mesh is not None
@@ -755,9 +805,20 @@ class DispatchPlane:
         if device is None:
             return
         if chaos.note_device_failure(device, self.quarantine_after):
-            from jepsen_tpu.checker.sharded import note_quarantine
-
             import logging
+
+            if chaos.is_tenant_label(device):
+                # A tenant breaker trip, not a chip ejection: the mesh
+                # is untouched; the service's admission door sheds the
+                # tenant (chaos.quarantined_tenants).
+                logging.getLogger("jepsen_tpu.checker").warning(
+                    "%s quarantined after %d attributed failures "
+                    "(%s: %s); its submissions shed at admission",
+                    device, self.quarantine_after,
+                    type(exc).__name__, exc,
+                )
+                return
+            from jepsen_tpu.checker.sharded import note_quarantine
 
             note_quarantine(device)
             logging.getLogger("jepsen_tpu.checker").warning(
@@ -795,24 +856,36 @@ class DispatchPlane:
             self._devices = jax.devices()[:1]
         return None, False
 
-    def _dispatch_resilient(self, launch_with, mesh=_UNSET):
+    def _dispatch_resilient(self, launch_with, mesh=_UNSET, tags=()):
         """Drive ``launch_with(mesh)`` down the degradation ladder:
         full mesh -> quarantine-resharded mesh -> single device.
         Returns (handle, mesh_used, None) on success or
         (None, None, PlaneFault) when every device rung failed — the
-        caller resolves the riders from the host oracle."""
+        caller resolves the riders from the host oracle. ``tags`` are
+        the riders' tenant pseudo-labels (_tenant_tags): they join the
+        guard's label list so faults can match and attribute by
+        tenant without ever naming a real chip."""
         mesh = self.mesh if mesh is _UNSET else mesh
         while True:
             try:
                 handle = self._guard(
                     "launch", lambda: launch_with(mesh),
-                    self._labels(mesh),
+                    self._labels(mesh) + list(tags),
                 )
                 return handle, mesh, None
             except PlaneFault as pf:
                 mesh, exhausted = self._after_fault(mesh)
                 if exhausted:
                     return None, None, pf
+
+    def _observe(self, fut: CheckFuture, kind: str) -> None:
+        cb = self.fault_observer
+        if cb is None or fut.tenant is None:
+            return
+        try:
+            cb(fut.tenant, kind)
+        except Exception:  # noqa: BLE001 - observers never wedge
+            pass
 
     def _oracle_resolve(self, futs, pf: PlaneFault) -> None:
         """The ladder's last rung: resolve each rider from the host
@@ -831,13 +904,16 @@ class DispatchPlane:
                 continue
             if f.events is None:
                 chaos.note_plane_fault()
+                self._observe(f, "plane_fault")
                 f._fail(pf)
                 continue
             chaos.note_oracle_fallback()
+            self._observe(f, "oracle_fallback")
             try:
                 out = _oracle_verdict(*_oracle_decide(f.events, f.model))
             except Exception as e:  # noqa: BLE001 - structured envelope
                 chaos.note_plane_fault()
+                self._observe(f, "plane_fault")
                 f._fail(PlaneFault(
                     site="oracle", kind="fatal", attempts=1, cause=e,
                 ))
@@ -881,7 +957,9 @@ class DispatchPlane:
                 interpret=interpret, exact=exact, mesh=mesh,
             )
 
-        handle, mesh_used, pf = self._dispatch_resilient(launch_with)
+        handle, mesh_used, pf = self._dispatch_resilient(
+            launch_with, tags=_tenant_tags(futs)
+        )
         if handle is None:
             self._oracle_resolve(futs, pf)
             return
@@ -933,7 +1011,9 @@ class DispatchPlane:
             args = tuple(jnp.asarray(c) for c in cols)
             return _wgl_vmap(*args, model_name=name, K=K, W=W)
 
-        handle, mesh_used, pf = self._dispatch_resilient(launch_with)
+        handle, mesh_used, pf = self._dispatch_resilient(
+            launch_with, tags=_tenant_tags(futs)
+        )
         if handle is None:
             self._oracle_resolve(futs, pf)
             return
@@ -966,7 +1046,7 @@ class DispatchPlane:
                 dev = devs[next(self._rr) % len(devs)]
             labels = (
                 [str(dev)] if dev is not None else self._labels(None)
-            )
+            ) + _tenant_tags([fut])
             try:
                 handle = self._guard(
                     "launch",
@@ -1062,7 +1142,9 @@ class DispatchPlane:
                     lambda: jax.device_get(
                         tuple(L.device_out() for L in prefix)
                     ),
-                    self._labels(self.mesh),
+                    self._labels(self.mesh) + _tenant_tags(
+                        [f for L in prefix for f in L.futs]
+                    ),
                 )
             except PlaneFault as pf:
                 try:
@@ -1263,7 +1345,7 @@ class DispatchPlane:
             )
 
         handle, mesh_used, pf = self._dispatch_resilient(
-            launch_with, mesh=use_mesh
+            launch_with, mesh=use_mesh, tags=_tenant_tags(futs)
         )
         if handle is None:
             # Raw steps carry no events to re-decide on the host: the
@@ -1290,11 +1372,17 @@ _DEFAULT_PLANE: Optional[DispatchPlane] = None
 _default_lock = threading.Lock()
 
 
-def default_plane() -> DispatchPlane:
+def default_plane(**kw) -> DispatchPlane:
+    """The process-wide plane, built lazily. Keyword arguments shape
+    the plane ONLY on first construction (the service daemon owns the
+    process and configures interpret/deadline/retry up front); later
+    callers get the existing plane unchanged — call
+    reset_default_plane() first to reconfigure."""
     global _DEFAULT_PLANE
     with _default_lock:
         if _DEFAULT_PLANE is None:
-            _DEFAULT_PLANE = DispatchPlane(async_prep=False)
+            kw.setdefault("async_prep", False)
+            _DEFAULT_PLANE = DispatchPlane(**kw)
         return _DEFAULT_PLANE
 
 

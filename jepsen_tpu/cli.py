@@ -265,9 +265,31 @@ def cmd_analyze(args) -> int:
     results["engine_stats"] = _engine_stats()
     test["results"] = results
     st.save_2(test)
+    if args.stats_json:
+        _dump_stats_json(args.stats_json)
     print(f"analyzed {run_dir}: valid?={results.get('valid?')}")
     print(_epitaph(_exit_code(results)))
     return _exit_code(results)
+
+
+def _dump_stats_json(path: str) -> None:
+    """Write the full engine-stats bundle — the same shape the daemon's
+    /stats endpoint serves — to `path` ("-" = stdout). Scripts that
+    scrape launches/resumes get one machine-readable artifact instead
+    of parsing results.json out of the run dir."""
+    import json
+
+    from jepsen_tpu.checker import dispatch
+
+    bundle = {"dispatch": dispatch.dispatch_stats(), **_engine_stats()}
+    if path == "-":
+        print(json.dumps(bundle, indent=2, default=str))
+    else:
+        from jepsen_tpu.store import atomic_write_text
+
+        atomic_write_text(
+            path, json.dumps(bundle, indent=2, default=str)
+        )
 
 
 def _engine_stats() -> dict:
@@ -287,6 +309,44 @@ def cmd_serve(args) -> int:
     from jepsen_tpu.web import serve
 
     serve(root=args.store, port=args.port)
+    return EXIT_VALID
+
+
+def cmd_daemon(args) -> int:
+    """Run the checker-as-a-service daemon (service/server.py): one
+    warm plane serving history checks for many tenants, with admission
+    control at the door and a SIGTERM-triggered graceful drain.
+    In-flight durable checks that outlive --drain-seconds are safe:
+    their verified frontier is already checkpointed, and a restarted
+    daemon resumes them on resubmission."""
+    from jepsen_tpu.service.drain import install_signal_drain
+    from jepsen_tpu.service.server import CheckerDaemon
+
+    _reset_engine_state()
+    daemon = CheckerDaemon(
+        root=args.store,
+        host=args.host,
+        port=args.port,
+        interpret=None,  # honor JEPSEN_TPU_INTERPRET like analyze
+        max_inflight=args.max_inflight,
+        per_tenant_inflight=args.tenant_inflight,
+        max_payload_bytes=args.max_payload_mb << 20,
+        strict_default=args.strict_history,
+        coalesce_hold_s=args.coalesce_hold,
+        launch_deadline_s=args.launch_deadline,
+        drain_s=args.drain_seconds,
+    )
+    handle = install_signal_drain(daemon.drain)
+    print(f"checker daemon serving on {daemon.url} "
+          f"(store={args.store})")
+    try:
+        daemon.serve_forever()
+    except KeyboardInterrupt:
+        daemon.drain()
+    finally:
+        handle.restore()
+        daemon.close()
+    print("checker daemon drained. (code 0)")
     return EXIT_VALID
 
 
@@ -348,12 +408,44 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--strict-history", action="store_true",
                    help="refuse (exit 3) instead of repairing when "
                         "the stored history fails sentry validation")
+    a.add_argument("--stats-json", default=None, metavar="PATH",
+                   help="also write the engine-stats bundle (launch/"
+                        "resilience/checkpoint, the /stats shape) as "
+                        "JSON to PATH ('-' = stdout)")
     a.set_defaults(fn=cmd_analyze)
 
     s = sub.add_parser("serve", help="web dashboard over the store")
     shared(s)
     s.add_argument("--port", type=int, default=8080)
     s.set_defaults(fn=cmd_serve)
+
+    d = sub.add_parser(
+        "daemon",
+        help="checker-as-a-service: a long-lived multi-tenant "
+             "analysis daemon over one warm dispatch plane",
+    )
+    shared(d)
+    d.add_argument("--host", default="127.0.0.1")
+    d.add_argument("--port", type=int, default=8008)
+    d.add_argument("--max-inflight", type=int, default=64,
+                   help="global in-flight check bound (429 past it)")
+    d.add_argument("--tenant-inflight", type=int, default=16,
+                   help="per-tenant in-flight cap (fairness floor)")
+    d.add_argument("--max-payload-mb", type=int, default=32,
+                   help="413 payloads above this many MiB")
+    d.add_argument("--strict-history", action="store_true",
+                   help="default tenant policy: refuse hostile "
+                        "histories (422) instead of repairing")
+    d.add_argument("--coalesce-hold", type=float, default=0.005,
+                   metavar="S",
+                   help="hold window between submit and resolve so "
+                        "concurrent tenants coalesce into one launch")
+    d.add_argument("--launch-deadline", type=float, default=None,
+                   metavar="S",
+                   help="per-launch deadline inherited by the plane")
+    d.add_argument("--drain-seconds", type=float, default=10.0,
+                   help="SIGTERM drain budget for in-flight checks")
+    d.set_defaults(fn=cmd_daemon)
     return p
 
 

@@ -301,6 +301,16 @@ def _repair(
     return fixed, repairs, quarantined
 
 
+def scan_history(history) -> Dict[str, int]:
+    """Detect-only entry: {corruption class: count}, empty when clean.
+    No repair, no raise — the shape services use to triage a payload
+    (admission logging, /stats attribution) without committing to the
+    strict-or-repair decision validate_history makes."""
+    if not isinstance(history, History):
+        history = History(history)
+    return _scan(history.ops)
+
+
 def validate_history(
     history, strict: bool = False
 ) -> Tuple[History, Dict]:

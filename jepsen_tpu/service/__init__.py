@@ -1,0 +1,54 @@
+"""Checker-as-a-service: a long-lived multi-tenant analysis daemon.
+
+The reference decouples test execution from analysis and serves the
+store over a long-lived process (jepsen/src/jepsen/web.clj serve!, the
+CLI's paired test/analyze commands); our TPU-resident analysis plane
+gets the same shape here — one warm daemon owning the process-wide
+mesh, memo, and compile caches (checker.dispatch.default_plane),
+serving history-check requests from many concurrent clients over
+stdlib HTTP/JSON on a local socket, and coalescing ACROSS tenants
+(the dispatch plane's bucket keying already coalesces same-shape
+submitters; the daemon's hold window gives concurrent requests time to
+meet in one bucket, so two tenants sharing a kernel shape pay one
+device launch).
+
+A shared accelerator plane is only viable if it is robust, so the
+robustness surface is the package's point:
+
+- admission control (``admission.py``): bounded in-flight queue,
+  payload size caps, and history-sentry validation at the door with a
+  per-tenant strict/repair policy — hostile inputs never reach the
+  encoder, oversized ones never reach RAM.
+- per-tenant fairness + backpressure: 429-style shedding past the
+  queue bound, per-tenant in-flight caps so one chatty tenant cannot
+  starve the rest, and per-request deadlines (the plane itself runs
+  under ``DispatchPlane(launch_deadline_s=...)``).
+- per-tenant isolation of the resilience machinery (``tenants.py``):
+  quarantine/retry/oracle-fallback events attribute to the submitting
+  tenant (dispatch's tenant tags ride the chaos guard labels), and a
+  tenant whose submissions keep faulting trips ITS OWN breaker in the
+  chaos quarantine registry — never a mesh reshard, never another
+  tenant's stats.
+- graceful drain (``drain.py``): SIGTERM stops admission (503), lets
+  in-flight checks finish inside a bounded budget, and relies on the
+  checkpoint sink's per-segment durability for anything longer — a
+  restarted daemon resumes a durable check at its last verified
+  frontier with an identical verdict.
+
+``client.py`` is the stdlib client library; bench.py routes through it
+to measure the warm-plane-vs-cold-process delta.
+"""
+
+from jepsen_tpu.service.admission import AdmissionControl, AdmissionError
+from jepsen_tpu.service.client import CheckerClient, ServiceError
+from jepsen_tpu.service.server import CheckerDaemon
+from jepsen_tpu.service.tenants import TenantLedger
+
+__all__ = [
+    "AdmissionControl",
+    "AdmissionError",
+    "CheckerClient",
+    "CheckerDaemon",
+    "ServiceError",
+    "TenantLedger",
+]

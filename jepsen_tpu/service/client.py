@@ -1,0 +1,140 @@
+"""Stdlib client for the checker daemon.
+
+One ``CheckerClient`` speaks to one daemon as one tenant. ``check()``
+serializes a history (a History, a list of Ops, or already-encoded
+dicts) through the store's canonical op JSON, POSTs it with the
+tenant header, and returns the verdict dict — raising ServiceError
+for every non-200, with bounded exponential backoff on the two
+retryable refusals (429 shed, 503 draining): backpressure the daemon
+emits becomes polite retry here, not a hot loop.
+
+bench.py routes through this client to measure the warm-plane vs
+cold-process delta; the tests use it as the tenant-side half of every
+service scenario.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import time
+from typing import Any, Iterable, Optional
+
+from jepsen_tpu.service.tenants import DEFAULT_TENANT
+
+#: refusals worth retrying — shed (429) and draining (503)
+RETRYABLE = frozenset({429, 503})
+
+
+class ServiceError(Exception):
+    """A non-200 daemon response: carries the HTTP ``status``, the
+    machine-readable ``reason`` slug, and the decoded ``body``."""
+
+    def __init__(self, status: int, reason: str, body: Optional[dict]):
+        self.status = status
+        self.reason = reason
+        self.body = body or {}
+        detail = self.body.get("detail", "")
+        super().__init__(
+            f"{status} {reason}" + (f": {detail}" if detail else "")
+        )
+
+
+def encode_history(history: Iterable) -> list:
+    """History | list[Op] | list[dict] -> wire ops (store op JSON)."""
+    from jepsen_tpu.store import op_to_json
+
+    ops = getattr(history, "ops", history)
+    return [o if isinstance(o, dict) else op_to_json(o) for o in ops]
+
+
+class CheckerClient:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8008,
+        tenant: str = DEFAULT_TENANT,
+        timeout_s: float = 120.0,
+        retries: int = 3,
+        backoff_s: float = 0.05,
+    ):
+        self.host = host
+        self.port = port
+        self.tenant = tenant
+        self.timeout_s = timeout_s
+        self.retries = max(int(retries), 0)
+        self.backoff_s = backoff_s
+
+    # -- transport -----------------------------------------------------
+
+    def _request(
+        self, method: str, path: str, body: Optional[bytes] = None
+    ) -> tuple:
+        """(status, decoded json) for one HTTP round trip; a fresh
+        connection per request keeps the client free of pooled-socket
+        state across daemon restarts (the drain tests kill daemons)."""
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout_s
+        )
+        try:
+            headers = {"X-Tenant": self.tenant}
+            if body is not None:
+                headers["Content-Type"] = "application/json"
+                headers["Content-Length"] = str(len(body))
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            try:
+                obj = json.loads(raw) if raw else {}
+            except ValueError:
+                obj = {"detail": raw.decode(errors="replace")}
+            return resp.status, obj
+        finally:
+            conn.close()
+
+    def _roundtrip(self, method: str, path: str,
+                   body: Optional[bytes] = None) -> dict:
+        delay = self.backoff_s
+        for attempt in range(self.retries + 1):
+            status, obj = self._request(method, path, body)
+            if status == 200:
+                return obj
+            if status in RETRYABLE and attempt < self.retries:
+                time.sleep(delay)
+                delay *= 2
+                continue
+            raise ServiceError(
+                status, obj.get("error", "error"), obj
+            )
+        raise AssertionError("unreachable")
+
+    # -- API -----------------------------------------------------------
+
+    def check(
+        self,
+        history,
+        model: Optional[str] = None,
+        durable: bool = False,
+        strict: Optional[bool] = None,
+        deadline_s: Optional[float] = None,
+        init_value: Any = None,
+    ) -> dict:
+        req: dict = {"history": encode_history(history)}
+        if model is not None:
+            req["model"] = model
+        if durable:
+            req["durable"] = True
+        if strict is not None:
+            req["strict"] = strict
+        if deadline_s is not None:
+            req["deadline_s"] = deadline_s
+        if init_value is not None:
+            req["init_value"] = init_value
+        body = json.dumps(req).encode()
+        return self._roundtrip("POST", "/check", body)
+
+    def stats(self) -> dict:
+        return self._roundtrip("GET", "/stats")
+
+    def health(self) -> dict:
+        return self._roundtrip("GET", "/healthz")

@@ -1,0 +1,410 @@
+"""The checker daemon: stdlib HTTP/JSON over a local socket.
+
+One long-lived process owns the warm mesh and the memo/compile caches
+(checker.dispatch.default_plane) and serves history-check requests
+from many concurrent clients. Handler threads submit through the
+shared plane inside a tenant context, then HOLD briefly before
+resolving (``coalesce_hold_s``) so concurrent same-shape requests —
+from different tenants — meet in one dispatch bucket and ride ONE
+stacked device launch: the cross-tenant coalescing the bucket keying
+already supports within a process, now offered across processes.
+
+Endpoints::
+
+    POST /check    {"model", "history": [op...], "durable", "strict",
+                    "deadline_s", "init_value"}  (tenant: X-Tenant)
+    GET  /stats    dispatch + launch + resilience + checkpoint +
+                   tenant-ledger + admission snapshots
+    GET  /healthz  liveness + drain state
+
+HTTP status mapping (the analyze exit-code contract, served):
+
+    200  verdict delivered ("valid?" true/false = exit 0/1)
+    400  malformed request (bad JSON / missing history)
+    411  missing Content-Length
+    413  payload over the admission cap
+    422  hostile history under a strict sentry policy   (= exit 3)
+    429  shed: queue bound / tenant cap / tenant breaker
+    500  analysis error                                  (= exit 2)
+    503  draining — resubmit after restart
+    504  request deadline_s expired (the check still completes and
+         warms the caches; only the response is abandoned)
+
+Durable checks (``"durable": true``) run through the PR 5 checkpoint
+sink keyed by a content-derived check id: every verified segment
+boundary persists into the store before the next launches, so a
+SIGKILL mid-check loses nothing — a resubmission of the SAME history
+(same id, any client, after any restart) resumes at the last durable
+frontier and the verdict carries the resume evidence in its
+"checkpoint" block.
+
+Graceful drain: ``drain()`` (wired to SIGTERM by ``cli.py daemon``)
+stops admission (new checks see 503), waits up to ``drain_s`` for
+in-flight checks to resolve, then stops the serve loop. In-flight
+durable checks that outlive the budget are safe by construction —
+their last verified boundary is already on disk.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from jepsen_tpu.checker import chaos, dispatch
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.sentry import HistorySentryError, validate_history
+from jepsen_tpu.service.admission import (
+    DEFAULT_MAX_INFLIGHT,
+    DEFAULT_MAX_PAYLOAD_BYTES,
+    DEFAULT_PER_TENANT_INFLIGHT,
+    AdmissionControl,
+    AdmissionError,
+)
+from jepsen_tpu.service.tenants import DEFAULT_TENANT, TenantLedger
+from jepsen_tpu.store import Store, op_from_json
+
+log = logging.getLogger("jepsen_tpu.service")
+
+#: default local port (0 = ephemeral, the tests' mode)
+DEFAULT_PORT = 8008
+
+#: default hold between submit and resolve — the coalescing window.
+#: Cheap against the ~94 ms device sync floor it amortizes; 0 disables.
+DEFAULT_COALESCE_HOLD_S = 0.005
+
+
+def check_id_for(model: str, body: bytes) -> str:
+    """Content-derived durable-check identity: the same history +
+    model from any client, before or after a daemon restart, maps to
+    the same checkpoint file — that is what makes resubmission resume
+    instead of restart."""
+    h = hashlib.sha256()
+    h.update(model.encode())
+    h.update(b"|")
+    h.update(body)
+    return h.hexdigest()[:16]
+
+
+def _jsonable(v: Any):
+    """Verdicts carry numpy scalars, tuples, and sets; the wire gets
+    plain JSON (tuples/sets as lists, non-str keys stringified)."""
+    if isinstance(v, dict):
+        return {
+            (k if isinstance(k, str) else str(k)): _jsonable(x)
+            for k, x in v.items()
+        }
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, (set, frozenset)):
+        return sorted(
+            (_jsonable(x) for x in v),
+            key=lambda e: json.dumps(e, sort_keys=True, default=str),
+        )
+    if hasattr(v, "item") and not isinstance(v, (str, bytes)):
+        try:
+            return v.item()  # numpy scalar
+        except Exception:  # noqa: BLE001
+            pass
+    if hasattr(v, "tolist"):
+        return v.tolist()  # numpy array
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+class CheckerDaemon:
+    """The long-lived multi-tenant analysis daemon (module docstring).
+
+    Parameters mirror the `cli.py daemon` flags. ``interpret=None``
+    reads JEPSEN_TPU_INTERPRET (the same CPU seam `analyze` uses).
+    The daemon takes ownership of the process-wide default plane:
+    construction resets and rebuilds it with this daemon's interpret /
+    deadline / retry configuration."""
+
+    def __init__(
+        self,
+        root: str = "store",
+        host: str = "127.0.0.1",
+        port: int = DEFAULT_PORT,
+        model: str = "cas-register",
+        interpret: Optional[bool] = None,
+        max_inflight: int = DEFAULT_MAX_INFLIGHT,
+        per_tenant_inflight: int = DEFAULT_PER_TENANT_INFLIGHT,
+        max_payload_bytes: int = DEFAULT_MAX_PAYLOAD_BYTES,
+        strict_default: bool = False,
+        tenant_quarantine_after: int = 5,
+        coalesce_hold_s: float = DEFAULT_COALESCE_HOLD_S,
+        launch_deadline_s: Optional[float] = None,
+        drain_s: float = 10.0,
+    ):
+        if interpret is None:
+            interpret = os.environ.get(
+                "JEPSEN_TPU_INTERPRET", ""
+            ) not in ("", "0")
+        self.root = root
+        self.model = model
+        self.interpret = interpret
+        self.coalesce_hold_s = max(float(coalesce_hold_s), 0.0)
+        self.drain_s = drain_s
+        self.store = Store(root)
+        self.ledger = TenantLedger(
+            strict_default=strict_default,
+            quarantine_after=tenant_quarantine_after,
+        )
+        self.admission = AdmissionControl(
+            self.ledger,
+            max_inflight=max_inflight,
+            per_tenant_inflight=per_tenant_inflight,
+            max_payload_bytes=max_payload_bytes,
+        )
+        # Own the process-wide plane: mesh + memo + compile caches live
+        # for the daemon's life; every tenant's checks share them.
+        dispatch.reset_default_plane()
+        self.plane = dispatch.default_plane(
+            model=model,
+            interpret=interpret,
+            launch_deadline_s=launch_deadline_s,
+        )
+        self.plane.fault_observer = self.ledger.observe_plane
+        self.started_at = time.time()
+        self._drained = threading.Event()
+        handler = type(
+            "Handler", (_Handler,), {"daemon_obj": self}
+        )
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.host, self.port = self.httpd.server_address[:2]
+
+    # -- lifecycle -----------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def serve_forever(self) -> None:
+        log.info("checker daemon serving on %s (store=%s)",
+                 self.url, self.root)
+        self.httpd.serve_forever(poll_interval=0.1)
+
+    def drain(self, signum: Optional[int] = None) -> bool:
+        """Graceful drain: stop admitting, wait (bounded) for
+        in-flight checks, stop the serve loop. Idempotent; safe from
+        any thread except the one inside serve_forever. Returns True
+        when every in-flight check resolved inside the budget."""
+        if self._drained.is_set():
+            return True
+        log.info(
+            "drain requested%s: admission closed, waiting up to "
+            "%.1fs for in-flight checks",
+            f" (signal {signum})" if signum else "", self.drain_s,
+        )
+        self.admission.start_drain()
+        clean = self.admission.wait_idle(self.drain_s)
+        if not clean:
+            log.warning(
+                "drain budget expired with checks in flight; durable "
+                "checks resume from their last checkpoint on restart"
+            )
+        self._drained.set()
+        self.httpd.shutdown()
+        return clean
+
+    def close(self) -> None:
+        """Release the socket. The default plane stays up (it is
+        process-wide); tests that cycle daemons reset it themselves."""
+        try:
+            self.httpd.server_close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "CheckerDaemon":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- the check pipeline (called from handler threads) --------------
+
+    def stats(self) -> dict:
+        return {
+            "dispatch": dispatch.dispatch_stats(),
+            "tenants": self.ledger.snapshot(),
+            "admission": self.admission.snapshot(),
+            "uptime_s": time.time() - self.started_at,
+            "draining": self.admission.draining,
+        }
+
+    def checkpoint_path(self, tenant: str, check_id: str) -> str:
+        return self.store.service_checkpoint_path(tenant, check_id)
+
+    def handle_check(self, tenant: str, body: bytes) -> tuple:
+        """(status, response dict) for one admitted check request.
+        The admission token is already held by the caller."""
+        try:
+            req = json.loads(body)
+            ops = req["history"]
+            if not isinstance(ops, list):
+                raise TypeError("history must be a list of ops")
+            history = History(
+                [op_from_json(d) for d in ops], indexed=True
+            )
+        except HistorySentryError:
+            raise
+        except Exception as e:  # noqa: BLE001 - malformed request
+            return 400, {"error": "bad-request", "detail": str(e)}
+        model = req.get("model", self.model)
+        durable = bool(req.get("durable"))
+        deadline_s = req.get("deadline_s")
+
+        # Sentry at the door, per-tenant policy: strict tenants get a
+        # 422 refusal (the exit-code-3 analog); repair tenants get a
+        # repaired history plus the report in their verdict. Either
+        # way nothing unvalidated ever reaches the encoder.
+        strict = self.ledger.strict(tenant, req.get("strict"))
+        try:
+            history, hreport = validate_history(history, strict=strict)
+        except HistorySentryError as e:
+            self.ledger.note(tenant, "hostile")
+            # Breaker evidence: a tenant spamming hostile histories
+            # eventually sheds at the door without sentry work.
+            self.ledger.note_fault(tenant)
+            return 422, {
+                "error": "hostile-history",
+                "classes": _jsonable(e.classes),
+                "detail": str(e),
+            }
+        if hreport is not None and not hreport.get("clean"):
+            self.ledger.note(tenant, "repaired")
+
+        check_id = check_id_for(model, body)
+
+        def run() -> dict:
+            from jepsen_tpu.checker.linearizable import (
+                LinearizableChecker,
+            )
+
+            checker = LinearizableChecker(
+                model=model,
+                init_value=req.get("init_value"),
+                plane=self.plane,
+                interpret=self.interpret,
+                sentry=False,  # the door already validated
+            )
+            with dispatch.tenant_context(tenant):
+                if durable:
+                    from jepsen_tpu.checker.checkpoint import (
+                        CheckpointSink,
+                    )
+
+                    self.ledger.note(tenant, "durable_checks")
+                    seg_env = os.environ.get("JEPSEN_TPU_SEG_MIN_LEN")
+                    sink = CheckpointSink(
+                        self.checkpoint_path(tenant, check_id),
+                        seg_min_len=int(seg_env) if seg_env else None,
+                    )
+                    out = checker.check({}, history, checkpoint=sink)
+                    if sink.resumed_from > 0:
+                        self.ledger.note(tenant, "durable_resumes")
+                    if sink.replayed:
+                        self.ledger.note(tenant, "durable_replays")
+                    return out
+                # The coalescing window: submit, hold, resolve — a
+                # concurrent same-shape request lands in the same
+                # bucket during the hold and shares the launch.
+                resolver = checker.check_async({}, history)
+                if self.coalesce_hold_s:
+                    time.sleep(self.coalesce_hold_s)
+                return resolver()
+
+        try:
+            if deadline_s is not None:
+                out = chaos.run_with_deadline(run, float(deadline_s))
+            else:
+                out = run()
+        except chaos.DeadlineExceeded:
+            self.ledger.note(tenant, "deadline_timeouts")
+            return 504, {
+                "error": "deadline-exceeded",
+                "deadline_s": deadline_s,
+                "check_id": check_id,
+            }
+        except Exception as e:  # noqa: BLE001 - the exit-2 analog
+            log.exception("check failed (tenant=%s)", tenant)
+            self.ledger.note(tenant, "errors")
+            return 500, {"error": "check-failed", "detail": str(e)}
+        self.ledger.note(tenant, "completed")
+        self.ledger.note(
+            tenant, "valid" if out.get("valid?") else "invalid"
+        )
+        out = _jsonable(out)
+        out["tenant"] = tenant
+        out["check_id"] = check_id
+        return 200, out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    daemon_obj: CheckerDaemon  # bound by CheckerDaemon.__init__
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send_json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _tenant(self) -> str:
+        t = (self.headers.get("X-Tenant") or "").strip()
+        return t or DEFAULT_TENANT
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        d = self.daemon_obj
+        if self.path == "/healthz":
+            self._send_json(200, {
+                "ok": True,
+                "draining": d.admission.draining,
+                "uptime_s": time.time() - d.started_at,
+            })
+            return
+        if self.path == "/stats":
+            self._send_json(200, _jsonable(d.stats()))
+            return
+        self._send_json(404, {"error": "not-found"})
+
+    def do_POST(self):  # noqa: N802 (stdlib API)
+        if self.path != "/check":
+            self._send_json(404, {"error": "not-found"})
+            return
+        d = self.daemon_obj
+        tenant = self._tenant()
+        cl = self.headers.get("Content-Length")
+        try:
+            d.admission.check_payload(
+                tenant, int(cl) if cl is not None else None
+            )
+            token = d.admission.admit(tenant)
+        except AdmissionError as e:
+            self._send_json(e.status, {
+                "error": e.reason, "detail": e.detail,
+            })
+            return
+        try:
+            body = self.rfile.read(int(cl))
+            status, obj = d.handle_check(tenant, body)
+        except Exception as e:  # noqa: BLE001 - last-resort envelope
+            log.exception("unhandled service error")
+            status, obj = 500, {
+                "error": "internal", "detail": str(e),
+            }
+        finally:
+            token.release()
+        self._send_json(status, obj)

@@ -233,6 +233,20 @@ class Store:
     def path(self, name: str, stamp: str) -> str:
         return os.path.join(self.root, name, stamp)
 
+    def service_checkpoint_path(self, tenant: str, check_id: str) -> str:
+        """Where the checker daemon persists a durable check's
+        segment checkpoint. Keyed by (tenant, content-derived check
+        id) so a resubmission of the same history — any client, any
+        daemon incarnation over this root — resumes the same file.
+        Tenant names come off the wire: keep only a safe slug so a
+        hostile tenant header cannot path-traverse out of the root."""
+        slug = "".join(
+            c if c.isalnum() or c in "-_" else "_" for c in tenant
+        ) or "default"
+        return os.path.join(
+            self.root, ".service", slug, check_id, "checkpoint.json"
+        )
+
     def make_run_dir(self, test: Dict[str, Any]) -> str:
         name = test.get("name", "noname")
         start = test.get("start_time", _time.time())

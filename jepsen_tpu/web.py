@@ -183,11 +183,23 @@ def make_server(root: str = "store", port: int = 8080):
 
 
 def serve(root: str = "store", port: int = 8080) -> None:
+    """Serve until SIGTERM/SIGINT; the first signal drains in-flight
+    responses (the poll loop exits between requests, never inside
+    one), the second kills outright (service.drain semantics)."""
+    from jepsen_tpu.service.drain import install_signal_drain
+
     srv = make_server(root, port)
     print(f"serving {root} on http://127.0.0.1:{port}")
+    handle = None
     try:
-        srv.serve_forever()
+        handle = install_signal_drain(lambda signum: srv.shutdown())
+    except ValueError:
+        pass  # non-main thread (embedded in tests): drain manually
+    try:
+        srv.serve_forever(poll_interval=0.1)
     except KeyboardInterrupt:
         pass
     finally:
+        if handle is not None:
+            handle.restore()
         srv.server_close()

@@ -33,9 +33,17 @@ jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_collection_modifyitems(config, items):
-    """@pytest.mark.mesh tests need a real multi-device mesh: skip them
+    """Two schedule tweaks.
+
+    @pytest.mark.service tests run LAST: each daemon takes ownership of
+    the process-wide dispatch plane and resets it (plus the resilience
+    ledger) on teardown, so they run after every suite that assumes a
+    quiet default engine rather than interleaving mid-alphabet.
+
+    @pytest.mark.mesh tests need a real multi-device mesh: skip them
     when the forced host-platform device count (or the actual device
     count) is 1, so JEPSEN_TPU_HOST_DEVICES=1 runs stay green."""
+    items.sort(key=lambda item: "service" in item.keywords)
     if len(jax.devices()) >= 2:
         return
     skip = pytest.mark.skip(reason="mesh tests need >=2 devices")

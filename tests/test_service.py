@@ -1,0 +1,635 @@
+"""Checker-as-a-service tests (service/): the hardened multi-tenant
+analysis daemon.
+
+The contract under test, per robustness surface:
+
+- roundtrip parity: a verdict served over the wire is the verdict the
+  checker produces locally — byte-identical modulo transport fields.
+- cross-tenant coalescing: two concurrent same-shape clients ride ONE
+  device launch where serial submission pays two (the LAUNCH_STATS
+  invariant, now across tenants).
+- admission: payload caps refuse before the body is read (413), the
+  bounded queue and per-tenant caps shed with 429, drain refuses 503.
+- isolation: a hostile tenant's sentry rejections trip ITS breaker
+  (shed at the door) and a tenant-targeted plane-fault storm degrades
+  only ITS checks to the host oracle — the concurrent clean tenant's
+  verdicts stay identical to solo runs, the mesh never shrinks.
+- durability: a durable check killed mid-run resumes from the
+  persisted frontier on resubmission to a fresh daemon, identical
+  verdict.
+
+The cheap in-process cases (roundtrip parity, the coalescing launch
+invariant, admission, sentry policy, drain) run in tier-1 (Pallas
+interpret mode); the heavier in-process differentials and the
+subprocess daemon SIGKILL/SIGTERM soaks are marked slow to respect
+the tier-1 wall budget.
+"""
+
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from glob import glob
+
+import pytest
+
+from jepsen_tpu.checker import chaos, dispatch
+from jepsen_tpu.checker import wgl_bitset as bs
+from jepsen_tpu.checker.checkpoint import CheckpointSink
+from jepsen_tpu.checker.linearizable import LinearizableChecker
+from jepsen_tpu.service.admission import AdmissionControl, AdmissionError
+from jepsen_tpu.service.client import CheckerClient, ServiceError
+from jepsen_tpu.service.client import encode_history
+from jepsen_tpu.service.server import CheckerDaemon, check_id_for
+from jepsen_tpu.service.tenants import TenantLedger
+from jepsen_tpu.sim import gen_register_history
+from jepsen_tpu.store import Store
+from test_checkpoint import burst_history
+
+pytestmark = pytest.mark.service
+
+
+@pytest.fixture
+def small_w(monkeypatch):
+    """Same speed seam as test_checkpoint: narrow W buckets so burst
+    histories segment at W4/W5 instead of W12/W13 in tier-1."""
+    monkeypatch.setattr(bs, "W_BUCKETS", (4, 5) + bs.W_BUCKETS)
+
+
+def _register(seed, n_ops=100):
+    """Clean same-shape histories: p_crash=0 + fixed n_ops keeps every
+    stream in one 64-bucket, so any two coalesce (test_dispatch's
+    convention)."""
+    return gen_register_history(
+        random.Random(seed), n_ops=n_ops, n_procs=4, p_crash=0.0
+    )
+
+
+def _strip(out):
+    """Verdict minus transport + per-run fields, normalized through
+    the wire encoding (tuples/sets/numpy -> plain JSON) so a local
+    reference compares equal to a served one."""
+    from jepsen_tpu.service.server import _jsonable
+
+    out = json.loads(json.dumps(_jsonable(out)))
+    return {
+        k: v for k, v in out.items()
+        if k not in ("method", "wall_s", "tenant", "check_id",
+                     "checkpoint", "degraded")
+    }
+
+
+HOSTILE_OPS = [
+    {"type": "invoke", "f": "read", "value": None, "process": 0,
+     "index": 0},
+    {"type": "ok", "f": "read", "value": 1, "process": 0, "index": 1},
+    {"type": "ok", "f": "read", "value": 2, "process": 0, "index": 2},
+]
+
+
+@contextmanager
+def running_daemon(tmp_path, **kw):
+    """An in-process daemon on an ephemeral port, torn down with the
+    engine state reset so breaker trips never leak across tests."""
+    kw.setdefault("interpret", True)
+    kw.setdefault("root", str(tmp_path / "store"))
+    daemon = CheckerDaemon(port=0, **kw)
+    t = threading.Thread(target=daemon.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield daemon
+    finally:
+        daemon.admission.start_drain()
+        daemon.httpd.shutdown()
+        t.join(timeout=10)
+        daemon.close()
+        dispatch.reset_default_plane()
+        chaos.reset_resilience()
+
+
+def _client(daemon, tenant="default", **kw):
+    kw.setdefault("retries", 0)
+    return CheckerClient(port=daemon.port, tenant=tenant, **kw)
+
+
+# -- roundtrip parity -------------------------------------------------
+
+
+def test_roundtrip_verdict_parity(tmp_path):
+    good = _register(101)
+    local_good = LinearizableChecker(interpret=True).check({}, good)
+    with running_daemon(tmp_path) as d:
+        c = _client(d, tenant="alice")
+        out = c.check(good, model="cas-register")
+        assert out["tenant"] == "alice" and out["check_id"]
+        assert _strip(out) == _strip(local_good)
+        # health + stats surfaces
+        assert c.health()["ok"] is True
+        st = c.stats()
+        assert st["tenants"]["alice"]["completed"] == 1
+        assert st["tenants"]["alice"]["valid"] == 1
+        assert st["dispatch"]["requests"] >= 1
+
+
+@pytest.mark.slow
+def test_roundtrip_invalid_verdict_parity(tmp_path):
+    from jepsen_tpu.sim import corrupt_history
+
+    rng = random.Random(55)
+    h = corrupt_history(_register(103), rng)
+    local = LinearizableChecker(interpret=True).check({}, h)
+    with running_daemon(tmp_path) as d:
+        out = _client(d).check(h, model="cas-register")
+        assert out["valid?"] is False
+        assert _strip(out) == _strip(local)
+        assert d.ledger.snapshot()["default"]["invalid"] == 1
+
+
+# -- cross-tenant coalescing (the acceptance invariant) ---------------
+
+
+def test_cross_tenant_coalescing_fewer_launches_than_serial(tmp_path):
+    """Two concurrent same-shape clients from different tenants meet
+    in one dispatch bucket during the hold window and ride ONE device
+    launch; the same two checks submitted serially pay two."""
+    ha, hb = _register(201), _register(202)
+    with running_daemon(tmp_path, coalesce_hold_s=0.4) as d:
+        ca, cb = _client(d, "alice"), _client(d, "bob")
+        # serial baseline (also warms the compile cache so the
+        # concurrent pass measures launches, not tracing)
+        bs.reset_launch_stats()
+        out_a = ca.check(ha, model="cas-register")
+        out_b = cb.check(hb, model="cas-register")
+        serial = bs.LAUNCH_STATS["launches"]
+        assert serial == 2
+
+        bs.reset_launch_stats()
+        outs = [None, None]
+
+        def go(i, cli, h):
+            outs[i] = cli.check(h, model="cas-register")
+
+        ts = [
+            threading.Thread(target=go, args=(0, ca, ha)),
+            threading.Thread(target=go, args=(1, cb, hb)),
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        concurrent = bs.LAUNCH_STATS["launches"]
+        assert concurrent == 1 < serial
+        assert _strip(outs[0]) == _strip(out_a)
+        assert _strip(outs[1]) == _strip(out_b)
+        # both tenants attributed, both rode the batch path
+        snap = d.ledger.snapshot()
+        assert snap["alice"]["completed"] == 2
+        assert snap["bob"]["completed"] == 2
+
+
+# -- admission --------------------------------------------------------
+
+
+def test_admission_payload_caps(tmp_path):
+    with running_daemon(tmp_path, max_payload_bytes=256) as d:
+        c = _client(d, tenant="hog")
+        with pytest.raises(ServiceError) as ei:
+            c.check(_register(301))
+        assert ei.value.status == 413
+        assert ei.value.reason == "payload-too-large"
+        assert d.ledger.snapshot()["hog"]["rejected_payload"] == 1
+        # under the cap (empty history) still parses -> 400 not 413
+        with pytest.raises(ServiceError) as ei:
+            c._roundtrip("POST", "/check", b"{}")
+        assert ei.value.status == 400
+
+
+def test_admission_queue_and_tenant_caps_unit():
+    """The shedding ladder, unit-level: global bound then per-tenant
+    cap, both 429; releases reopen the door; drain flips to 503."""
+    ledger = TenantLedger()
+    ctl = AdmissionControl(
+        ledger, max_inflight=3, per_tenant_inflight=2
+    )
+    t1 = ctl.admit("a")
+    t2 = ctl.admit("a")
+    with pytest.raises(AdmissionError) as ei:
+        ctl.admit("a")  # per-tenant cap first
+    assert ei.value.status == 429
+    assert ei.value.reason == "tenant-inflight-cap"
+    t3 = ctl.admit("b")
+    with pytest.raises(AdmissionError) as ei:
+        ctl.admit("c")  # global bound
+    assert ei.value.reason == "queue-full"
+    t3.release()
+    ctl.admit("c").release()  # reopened
+    assert ledger.snapshot()["a"]["shed"] == 1
+    ctl.start_drain()
+    with pytest.raises(AdmissionError) as ei:
+        ctl.admit("b")
+    assert ei.value.status == 503
+    t1.release()
+    t2.release()
+    assert ctl.wait_idle(1.0) is True
+
+
+@pytest.mark.slow
+def test_request_deadline_maps_to_504(tmp_path):
+    with running_daemon(tmp_path) as d:
+        c = _client(d, tenant="impatient", timeout_s=300)
+        with pytest.raises(ServiceError) as ei:
+            c.check(_register(303), deadline_s=1e-4)
+        assert ei.value.status == 504
+        assert d.ledger.snapshot()["impatient"][
+            "deadline_timeouts"
+        ] == 1
+        # the abandoned check still completes and releases its slot
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if d.admission.snapshot()["inflight"] == 0:
+                break
+            time.sleep(0.05)
+        assert d.admission.snapshot()["inflight"] == 0
+
+
+# -- sentry policy + hostile-tenant isolation -------------------------
+
+
+def test_strict_policy_rejects_hostile_history(tmp_path):
+    with running_daemon(tmp_path) as d:
+        c = _client(d, tenant="mallory")
+        # default policy repairs: hostile ops still get a verdict
+        out = c.check(HOSTILE_OPS)
+        assert "valid?" in out
+        assert d.ledger.snapshot()["mallory"]["repaired"] == 1
+        # request-level strict override refuses with the class census
+        with pytest.raises(ServiceError) as ei:
+            c.check(HOSTILE_OPS, strict=True)
+        assert ei.value.status == 422
+        assert ei.value.reason == "hostile-history"
+        assert ei.value.body["classes"]
+        # tenant-level policy: same refusal without the override
+        d.ledger.set_policy("mallory", strict=True)
+        with pytest.raises(ServiceError) as ei:
+            c.check(HOSTILE_OPS)
+        assert ei.value.status == 422
+
+
+@pytest.mark.slow
+def test_hostile_tenant_sheds_while_clean_tenant_unperturbed(tmp_path):
+    """The isolation acceptance: a tenant spamming hostile payloads
+    trips its breaker and sheds at the door; a concurrent clean
+    tenant's verdict stays identical to its solo run, its ledger row
+    untouched by the storm."""
+    h_clean = _register(401)
+    solo = LinearizableChecker(interpret=True).check({}, h_clean)
+    with running_daemon(
+        tmp_path, strict_default=True, tenant_quarantine_after=3
+    ) as d:
+        evil = _client(d, tenant="evil")
+        clean = _client(d, tenant="clean")
+        stop = threading.Event()
+        codes = []
+
+        def storm():
+            while not stop.is_set():
+                try:
+                    evil.check(HOSTILE_OPS)
+                except ServiceError as e:
+                    codes.append(e.status)
+                    if e.status == 429:
+                        return
+
+        st = threading.Thread(target=storm)
+        st.start()
+        t0 = time.perf_counter()
+        out = clean.check(h_clean, model="cas-register",
+                          strict=False)
+        clean_wall = time.perf_counter() - t0
+        st.join(timeout=60)
+        stop.set()
+        assert not st.is_alive()
+        # breaker arc: strict 422s until the trip, then shed 429
+        assert codes.count(422) >= 3
+        assert codes[-1] == 429
+        assert d.ledger.quarantined("evil")
+        with pytest.raises(ServiceError) as ei:
+            evil.check(HOSTILE_OPS)
+        assert ei.value.reason == "tenant-quarantined"
+        # the clean tenant never noticed
+        assert _strip(out) == _strip(solo)
+        snap = d.ledger.snapshot()
+        assert snap["clean"]["hostile"] == 0
+        assert snap["clean"]["faults"] == 0
+        assert not snap["clean"]["quarantined"]
+        assert clean_wall < 60.0
+        # /stats surfaces the quarantine
+        assert "evil" in d.stats()["dispatch"]["resilience"][
+            "quarantined_tenants"
+        ]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_tenant_targeted_fault_degrades_only_that_tenant(tmp_path):
+    """A persistent plane fault matching one tenant's pseudo-label
+    walks the ladder down to the host oracle for THAT tenant's checks
+    only: verdicts still correct (oracle parity), the fault attributed
+    to its row, the clean tenant's checks stay on the device path, and
+    no chip is ever quarantined (tenant labels never match the mesh)."""
+    h_evil, h_clean = _register(501, n_ops=60), _register(502)
+    ref_evil = LinearizableChecker(interpret=True).check({}, h_evil)
+    ref_clean = LinearizableChecker(interpret=True).check({}, h_clean)
+    with running_daemon(
+        tmp_path, coalesce_hold_s=0.0, tenant_quarantine_after=100
+    ) as d:
+        d.plane.retry = chaos.RetryPolicy(
+            max_retries=1, base_delay_s=0.001
+        )
+        evil = _client(d, tenant="evil", timeout_s=300)
+        clean = _client(d, tenant="clean", timeout_s=300)
+        with chaos.chaos_plan(
+            chaos.persistent_device_fault(chaos.TENANT_PREFIX + "evil")
+        ):
+            out_e = evil.check(h_evil, model="cas-register")
+            out_c = clean.check(h_clean, model="cas-register")
+        # oracle verdicts carry fewer bookkeeping fields than the
+        # device path (test_chaos convention): compare the semantics
+        assert out_e["valid?"] == ref_evil["valid?"]
+        assert out_e.get("failed_op_index") == ref_evil.get(
+            "failed_op_index"
+        )
+        assert out_e["method"].startswith("cpu-oracle")
+        assert _strip(out_c) == _strip(ref_clean)
+        assert not out_c["method"].startswith("cpu-oracle")
+        snap = d.ledger.snapshot()
+        assert snap["evil"]["oracle_fallbacks"] >= 1
+        assert snap["clean"]["oracle_fallbacks"] == 0
+        assert snap["clean"]["plane_faults"] == 0
+        res = chaos.resilience_snapshot()
+        assert res["quarantined_devices"] == []  # mesh never shrinks
+
+
+# -- durable checks: restart + resubmit resumes -----------------------
+
+
+@pytest.mark.slow
+@pytest.mark.durability
+def test_durable_resubmit_after_kill_resumes_frontier(
+    tmp_path, small_w, monkeypatch
+):
+    """The drain differential, in-process: a durable check dies after
+    2 verified segments (simulated kill via the after_save crash hook
+    at the daemon's own checkpoint path); a FRESH daemon over the same
+    store serves a resubmission of the same payload by resuming at the
+    persisted frontier — identical verdict, resume evidence on the
+    wire."""
+    monkeypatch.setenv("JEPSEN_TPU_SEG_MIN_LEN", "1")
+    h = burst_history(rounds=2, nburst=5)
+    cold = LinearizableChecker(interpret=True).check(
+        {}, burst_history(rounds=2, nburst=5)
+    )
+    body = json.dumps({
+        "history": encode_history(h),
+        "model": "cas-register",
+        "durable": True,
+    }).encode()
+    check_id = check_id_for("cas-register", body)
+    root = str(tmp_path / "store")
+    path = Store(root).service_checkpoint_path("default", check_id)
+
+    class Die(Exception):
+        pass
+
+    def die_after_2(sink, st):
+        if st.get("verdict") is None and st["segments_done"] >= 2:
+            raise Die()
+
+    with pytest.raises(Die):
+        LinearizableChecker(interpret=True).check(
+            {}, burst_history(rounds=2, nburst=5),
+            checkpoint=CheckpointSink(
+                path, seg_min_len=1, after_save=die_after_2
+            ),
+        )
+    assert os.path.exists(path)  # the durable frontier survived
+
+    with running_daemon(tmp_path, root=root) as d:
+        out = _client(d)._roundtrip("POST", "/check", body)
+        assert out["check_id"] == check_id
+        assert out["checkpoint"]["resumed_from_segment"] == 2
+        assert out["valid?"] == cold["valid?"]
+        assert d.ledger.snapshot()["default"]["durable_resumes"] == 1
+        # resubmitting the finished check replays launch-free
+        bs.reset_launch_stats()
+        out2 = _client(d)._roundtrip("POST", "/check", body)
+        assert out2["checkpoint"]["replayed_verdict"] is True
+        assert bs.LAUNCH_STATS["launches"] == 0
+        assert out2["valid?"] == cold["valid?"]
+
+
+# -- graceful drain ---------------------------------------------------
+
+
+def test_drain_refuses_new_checks_and_waits_idle(tmp_path):
+    with running_daemon(tmp_path, coalesce_hold_s=0.0) as d:
+        c = _client(d)
+        c.check(_register(601))  # warm
+        assert d.drain() is True  # nothing in flight: clean
+        assert d.admission.draining
+        with pytest.raises(AdmissionError) as ei:
+            d.admission.admit("late")
+        assert ei.value.status == 503
+
+
+# -- subprocess soaks: the real daemon lifecycle ----------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_daemon(root, port, extra=()):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        JEPSEN_TPU_INTERPRET="1",
+        JEPSEN_TPU_SEG_MIN_LEN="1",
+    )
+    cmd = [
+        sys.executable, "-m", "jepsen_tpu.cli", "daemon",
+        "--store", root, "--port", str(port), *extra,
+    ]
+    return subprocess.Popen(
+        cmd, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+
+
+def _wait_healthy(port, timeout_s=120):
+    c = CheckerClient(port=port, timeout_s=5, retries=0)
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            if c.health().get("ok"):
+                return c
+        except Exception:  # noqa: BLE001 - not up yet
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(f"daemon on :{port} never became healthy")
+
+
+@pytest.mark.slow
+@pytest.mark.durability
+def test_daemon_sigkill_restart_resubmit_resumes(tmp_path):
+    """The acceptance drain differential, full-fidelity: SIGKILL a
+    real daemon subprocess mid-durable-check, start a fresh daemon
+    over the same store, resubmit the identical payload — the check
+    resumes from the persisted frontier (resume evidence on the wire)
+    and the verdict matches an uninterrupted run."""
+    root = str(tmp_path / "store")
+    port = _free_port()
+    h = burst_history(rounds=12)
+    proc = _spawn_daemon(root, port)
+    try:
+        client = _wait_healthy(port)
+        client.timeout_s = 600
+
+        result = {}
+
+        def submit():
+            try:
+                result["out"] = client.check(
+                    h, model="cas-register", durable=True
+                )
+            except Exception as e:  # noqa: BLE001 - killed mid-check
+                result["err"] = e
+
+        t = threading.Thread(target=submit)
+        t.start()
+        # poll the service checkpoint for durable progress, then kill
+        pattern = os.path.join(
+            root, ".service", "default", "*", "checkpoint.json"
+        )
+        seen = 0
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            for p in glob(pattern):
+                try:
+                    seen = max(
+                        seen,
+                        json.load(open(p)).get("segments_done", 0),
+                    )
+                except (OSError, ValueError):
+                    pass
+            if seen >= 3 or "out" in result:
+                break
+            time.sleep(0.05)
+        assert "out" not in result, (
+            "check finished before the kill landed; grow the history"
+        )
+        assert seen >= 3
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=60)
+        t.join(timeout=60)
+        assert "out" not in result  # the first submission died
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=60)
+
+    # fresh daemon, same store: resubmission resumes, not restarts
+    port2 = _free_port()
+    proc2 = _spawn_daemon(root, port2)
+    try:
+        client2 = _wait_healthy(port2)
+        client2.timeout_s = 600
+        out = client2.check(h, model="cas-register", durable=True)
+        assert out["checkpoint"]["resumed_from_segment"] >= 3
+        st = client2.stats()
+        assert st["tenants"]["default"]["durable_resumes"] == 1
+        # uninterrupted reference from the same warm daemon (fresh
+        # payload identity via a trailing no-op tenant: just rebuild
+        # the history object — same content, different store slot is
+        # NOT what we want, so run it locally instead)
+        cold = LinearizableChecker(interpret=True).check(
+            {}, burst_history(rounds=12)
+        )
+        assert out["valid?"] == cold["valid?"]
+        assert out.get("failed_op_index") == cold.get(
+            "failed_op_index"
+        )
+    finally:
+        proc2.send_signal(signal.SIGTERM)
+        assert proc2.wait(timeout=60) == 0  # graceful drain exits 0
+        if proc2.poll() is None:
+            proc2.kill()
+
+
+@pytest.mark.slow
+def test_daemon_sigterm_drains_inflight_then_exits_zero(tmp_path):
+    """SIGTERM mid-check: the daemon stops admitting (503 at the
+    door), the in-flight check still gets its 200, and the process
+    exits 0 inside the drain budget."""
+    root = str(tmp_path / "store")
+    port = _free_port()
+    h = burst_history(rounds=6)
+    proc = _spawn_daemon(
+        root, port, extra=("--drain-seconds", "300")
+    )
+    try:
+        client = _wait_healthy(port)
+        client.timeout_s = 600
+        result = {}
+
+        def submit():
+            try:
+                result["out"] = client.check(
+                    h, model="cas-register", durable=True
+                )
+            except Exception as e:  # noqa: BLE001
+                result["err"] = e
+
+        t = threading.Thread(target=submit)
+        t.start()
+        # wait for the check to be admitted, then SIGTERM
+        pattern = os.path.join(
+            root, ".service", "default", "*", "checkpoint.json"
+        )
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            if glob(pattern) or "out" in result:
+                break
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        # a late submission sheds with 503 (or the socket is already
+        # down, which is also a refusal)
+        try:
+            CheckerClient(
+                port=port, tenant="late", timeout_s=10, retries=0
+            ).check(_register(701))
+            refused = False
+        except (ServiceError, OSError) as e:
+            refused = (
+                getattr(e, "status", None) == 503
+                or isinstance(e, OSError)
+            )
+        assert refused
+        t.join(timeout=540)
+        assert proc.wait(timeout=540) == 0
+        assert "out" in result, result.get("err")
+        assert "valid?" in result["out"]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=60)

@@ -1,0 +1,125 @@
+"""Web dashboard tests (web.py): the browse/export surface the serve
+command exposes over the store.
+
+Pins the pieces a refactor would silently break: the index table's
+validity colors (web.clj:25-34's green/red/orange), the zip export of
+a run directory, the `_inside` path-traversal guard (both the pure
+function and the HTTP 403 it produces), and the graceful drain wiring
+`serve` shares with the checker daemon.
+"""
+
+import io
+import os
+import threading
+import zipfile
+
+import pytest
+
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.ops import invoke_op, ok_op
+from jepsen_tpu.store import Store
+from jepsen_tpu.web import (
+    _COLORS,
+    _inside,
+    make_server,
+    render_index,
+    zip_dir,
+)
+
+
+@pytest.fixture
+def seeded_store(tmp_path):
+    """Two stored runs: one valid, one invalid, plus an orphan file
+    OUTSIDE the root for the traversal tests to aim at."""
+    root = str(tmp_path / "store")
+    st = Store(root)
+    for name, valid in (("good-test", True), ("bad-test", False)):
+        h = History([invoke_op(0, "write", 1), ok_op(0, "write", 1)])
+        test = {"name": name, "history": h}
+        st.make_run_dir(test)
+        st.save_1(test)
+        test["results"] = {"valid?": valid}
+        st.save_2(test)
+    secret = tmp_path / "secret.txt"
+    secret.write_text("outside the store")
+    return st, str(secret)
+
+
+def test_index_renders_runs_with_validity_colors(seeded_store):
+    st, _ = seeded_store
+    page = render_index(st)
+    assert "good-test" in page and "bad-test" in page
+    assert _COLORS[True] in page   # green row for the valid run
+    assert _COLORS[False] in page  # red row for the invalid run
+    assert page.count("/zip/") == 2
+
+
+def test_zip_export_contains_run_artifacts(seeded_store):
+    st, _ = seeded_store
+    name, stamps = next(iter(st.tests().items()))
+    out = zip_dir(st.root, os.path.join(name, stamps[-1]))
+    assert out is not None
+    buf, size, fname = out
+    assert size > 0 and fname.endswith(".zip")
+    with zipfile.ZipFile(io.BytesIO(buf.read())) as zf:
+        names = zf.namelist()
+    assert "test.json" in names
+    assert "history.jsonl" in names
+    assert "results.json" in names
+
+
+def test_zip_export_refuses_paths_outside_root(seeded_store):
+    st, _ = seeded_store
+    assert zip_dir(st.root, "../") is None
+    assert zip_dir(st.root, "../../") is None
+
+
+def test_inside_guard(tmp_path):
+    root = str(tmp_path / "store")
+    os.makedirs(root)
+    assert _inside(root, os.path.join(root, "run", "test.json"))
+    assert _inside(root, root)
+    assert not _inside(root, str(tmp_path / "secret.txt"))
+    assert not _inside(root, os.path.join(root, "..", "secret.txt"))
+    # prefix confusion: /store-evil is not inside /store
+    assert not _inside(root, root + "-evil")
+
+
+def test_http_traversal_rejected_and_index_served(seeded_store):
+    """End-to-end over a real socket: / renders, /files/<run>/ lists,
+    and an escape attempt gets 403 — never file content."""
+    import http.client
+
+    st, secret = seeded_store
+    srv = make_server(root=st.root, port=0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        def get(path):
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=10)
+            try:
+                conn.request("GET", path)
+                r = conn.getresponse()
+                return r.status, r.read()
+            finally:
+                conn.close()
+
+        status, body = get("/")
+        assert status == 200 and b"good-test" in body
+        name, stamps = next(iter(st.tests().items()))
+        status, body = get(f"/files/{name}/{stamps[-1]}/")
+        assert status == 200 and b"results.json" in body
+        status, body = get("/files/../secret.txt")
+        assert status == 403
+        assert b"outside the store" not in body
+        status, body = get("/files/..%2f..%2fsecret.txt")
+        assert status == 403
+        assert b"outside the store" not in body
+        status, _ = get("/zip/../")
+        assert status == 404
+    finally:
+        srv.shutdown()
+        t.join(timeout=10)
+        srv.server_close()
