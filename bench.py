@@ -441,6 +441,10 @@ def _register_plane_pipelined(etcd, zk, ns, interpret=False):
     for s in etcd + zk + [ns]:
         clear_memos(s)
     reset_dispatch_stats()
+    # Residency deltas, snapshot-not-reset: LAUNCH_STATS is cumulative
+    # across the whole bench (engine_stats publishes it), so the
+    # pipelined pass measures itself by differencing around the run.
+    l0 = dict(bs.LAUNCH_STATS)
     walls = {}
     t0 = time.perf_counter()
     # coalesce window >> prep time: the explicit flush below decides
@@ -461,7 +465,21 @@ def _register_plane_pipelined(etcd, zk, ns, interpret=False):
         ns_out = ns_fut.result()
         walls["northstar-100k"] = time.perf_counter() - t0
     ok = all(o["valid?"] for o in etcd_out + zk_out + [ns_out])
-    return ok, walls, dispatch_stats()
+    dstats = dispatch_stats()
+    n_checks = len(etcd) + len(zk) + 1
+    syncs = bs.LAUNCH_STATS["host_syncs"] - l0.get("host_syncs", 0)
+    dstats["residency"] = {
+        "host_round_trips": syncs,
+        "donated_buffers": (
+            bs.LAUNCH_STATS["donated_buffers"]
+            - l0.get("donated_buffers", 0)
+        ),
+        "syncs_per_check": syncs / n_checks,
+        "double_buffer_occupancy": dstats.get(
+            "double_buffer_occupancy", 0.0
+        ),
+    }
+    return ok, walls, dstats
 
 
 def bench_race_parity(streams, expected):
@@ -1234,6 +1252,18 @@ def main() -> None:
                 # floor_amortization = requests served per device sync
                 # — conventions in BENCH_NOTES.md).
                 "dispatch_stats": pipeline.get("dispatch_stats"),
+                # residency: the device-residency accounting for the
+                # suite-mode pass — host_round_trips is how many times
+                # anything crossed the tunnel, syncs_per_check the
+                # amortized sync floor each check actually paid,
+                # donated_buffers the launches whose frontier aliased
+                # in place, double_buffer_occupancy the mean in-flight
+                # trains per register (2.0 = fully double-buffered).
+                "residency": (
+                    (pipeline.get("dispatch_stats") or {}).get(
+                        "residency"
+                    )
+                ),
                 # mesh: the scale-out record — device count, whether
                 # the sharded path engaged (the exit-4 guard above),
                 # and the zookeeper single-vs-sharded scaling ratio

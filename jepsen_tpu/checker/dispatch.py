@@ -134,6 +134,22 @@ DISPATCH_STATS = {
     "native_wins": 0,
     "worker_errors": 0,
     "pending_at_close": 0,
+    # Durable (checkpointed) routing after residency: single-segment
+    # plans ride the normal coalescing buckets (durable_coalesced,
+    # incl. zero-launch checkpoint replays resolved at prep), while
+    # multi-segment plans run the resident checkpointed group driver
+    # on the collecting thread (durable_solo).
+    "durable_coalesced": 0,
+    "durable_solo": 0,
+    # Double-buffered collect trains: every launch registration samples
+    # how many unresolved trains are in flight (train_inflight_accum /
+    # train_registers = double_buffer_occupancy; 2.0 means collect of
+    # train N fully overlaps launch of train N+1). Registrations past
+    # max_inflight_trains collect the oldest train first
+    # (backpressure_collects) — bounded device memory, pipelined syncs.
+    "train_registers": 0,
+    "train_inflight_accum": 0,
+    "backpressure_collects": 0,
 }
 
 _stats_lock = threading.Lock()
@@ -213,6 +229,11 @@ def dispatch_stats() -> dict:
         )
     out["per_device"] = per_dev
     out["n_devices"] = len(per_dev)
+    out["double_buffer_occupancy"] = (
+        out["train_inflight_accum"] / out["train_registers"]
+        if out["train_registers"]
+        else 0.0
+    )
     out["launch"] = dict(bs.LAUNCH_STATS)
     res = chaos.resilience_snapshot()
     res["worker_errors"] = out["worker_errors"]
@@ -398,6 +419,7 @@ class DispatchPlane:
         launch_deadline_s: Optional[float] = None,
         quarantine_after: int = 3,
         worker_join_s: float = 10.0,
+        max_inflight_trains: int = 2,
     ):
         from jepsen_tpu.checker.sharded import resolve_mesh
 
@@ -406,6 +428,11 @@ class DispatchPlane:
         self.race = race
         self.max_batch = max_batch
         self.coalesce_wait_s = coalesce_wait_us / 1e6
+        #: double-buffered collect trains: at most this many unresolved
+        #: launches in flight; registering one more collects the oldest
+        #: first (its device->host copy started at registration, so
+        #: that collect overlaps the newer train's device execution).
+        self.max_inflight_trains = max(int(max_inflight_trains), 1)
         self.retry = retry or chaos.DEFAULT_RETRY
         self.launch_deadline_s = launch_deadline_s
         self.quarantine_after = quarantine_after
@@ -448,12 +475,14 @@ class DispatchPlane:
         """Queue one event-stream check; returns its CheckFuture.
 
         checkpoint: a checkpoint.CheckpointSink makes this check
-        durable — it resolves through the segment-at-a-time
-        checkpointed driver (check_events_bucketed(checkpoint=...))
-        instead of riding a coalesced batch: durability means a host
-        sync per segment, which is incompatible with sharing one
-        launch train, so checkpointed checks trade coalescing for
-        crash-safe resume."""
+        durable. Durable checks classify like any other: a
+        single-segment plan rides a normal coalesced bucket (the sink
+        replays a finished verdict at prep with zero launches and
+        records the verdict at resolve), while a multi-segment plan
+        runs the resident checkpointed group driver — one launch and
+        one host sync per `every=N` persistence boundary — on the
+        collecting thread. Streams outside the bitset envelope ignore
+        the sink (nothing durable to record segment-wise)."""
         fut = CheckFuture(self, events, model or self.model)
         fut.checkpoint = checkpoint
         _bump("requests")
@@ -635,10 +664,12 @@ class DispatchPlane:
         except BaseException as e:  # noqa: BLE001 - delivered at result()
             fut._fail(e)
             return
+        if fut.kind == "done":
+            return  # resolved at prep (checkpoint replay)
         if fut.kind == "segmented":
             self._dispatch_segmented(fut)
-        elif fut.kind == "fallback":
-            _bump("fallbacks")
+        elif fut.kind in ("fallback", "durable"):
+            _bump("fallbacks" if fut.kind == "fallback" else "durable_solo")
             with self._lock:
                 self._fallbacks.append(fut)
         else:
@@ -659,12 +690,6 @@ class DispatchPlane:
         tier order exactly (bitset plan on the ORIGINAL model, then
         packed substitution, then the K-ladder envelope)."""
         ev = fut.events
-        if fut.checkpoint is not None:
-            # Durable check: resolved via the checkpointed segmented
-            # driver on the collecting thread (the fallback rail — no
-            # coalescing; see submit()).
-            fut.kind = "fallback"
-            return
         m = get_model(fut.model)
         device_ok = _on_tpu() or self.interpret
         plan = (
@@ -678,10 +703,30 @@ class DispatchPlane:
             fut.steps = steps
             fut.S = S
             fut.W = bW
-            segs = bs._plan_for(steps, None)
-            if len(segs) > 1:
-                fut.kind = "segmented"
-                return
+            if fut.checkpoint is not None:
+                # Durable checks plan with the SINK's segment floor so
+                # the content hash matches the sequential checkpointed
+                # driver (replay/resume interchange across both paths).
+                segs = bs._plan_for(steps, fut.checkpoint.seg_min_len)
+                if len(segs) > 1:
+                    # Multi-segment durable plan: the resident group
+                    # driver is its own launch loop (a durable boundary
+                    # per `every` segments) — resolved on the
+                    # collecting thread, not a shared bucket.
+                    fut.kind = "durable"
+                    return
+                # Single-segment durable plan: ride a normal coalesced
+                # bucket. A finished checkpoint replays right here with
+                # zero launches; otherwise the sink records the verdict
+                # when the bucket resolves (_checkpoint_finish).
+                _bump("durable_coalesced")
+                if self._checkpoint_replay(fut, steps, m.name, S, segs):
+                    return
+            else:
+                segs = bs._plan_for(steps, None)
+                if len(segs) > 1:
+                    fut.kind = "segmented"
+                    return
             fut.kind = "bitset"
             n = bucket(max(len(steps), 1), 64)
             fut.key = (
@@ -755,12 +800,32 @@ class DispatchPlane:
             fut.racer = _NativeRacer(fut.events, fut.model)
 
     def _register_launch(self, launch: _Launch) -> None:
+        """Register one in-flight train, double-buffered. The
+        device->host copy of this train's outputs starts NOW
+        (copy_to_host_async), so it overlaps the next train's host prep
+        and device work; the later collect's device_get then mostly
+        finds bytes already landed. At most ``max_inflight_trains``
+        stay unresolved — registering past the cap collects the oldest
+        train on THIS thread, which is exactly the backpressure that
+        keeps an unbounded submit burst from queueing device memory."""
+        try:
+            for leaf in jax.tree_util.tree_leaves(launch.device_out()):
+                leaf.copy_to_host_async()
+        except Exception:  # noqa: BLE001 - overlap is best-effort
+            pass
         with self._lock:
             self._launched.append(launch)
+            pending = [L for L in self._launched if not L.resolved]
+        _bump("train_registers")
+        _bump("train_inflight_accum", len(pending))
         for f in launch.futs:
             f.launch = launch
         for f in launch.futs:
             self._start_racer(f)
+        excess = len(pending) - self.max_inflight_trains
+        if excess > 0:
+            _bump("backpressure_collects", excess)
+            self._collect_upto(pending[excess - 1])
 
     def _note_launch(self, n_requests: int, mesh=None) -> None:
         """Per-device accounting for one dispatch. A mesh-sharded
@@ -1080,7 +1145,7 @@ class DispatchPlane:
         self._pump(flush_futs=(fut,))
         if fut.done():
             return
-        if fut.kind == "fallback":
+        if fut.kind in ("fallback", "durable"):
             self._resolve_fallbacks()
             return
         while not fut.done():
@@ -1137,6 +1202,11 @@ class DispatchPlane:
                 # exists for) and retries, and an exhausted budget
                 # degrades every rider below — the collecting thread
                 # and the prep worker always come back.
+                # One host sync for the whole train prefix (the
+                # residency metric counts it; _register_launch started
+                # the device->host copies, so by now the transfer has
+                # mostly overlapped newer launches' device work).
+                bs._bump_launch("host_syncs")
                 host = self._guard(
                     "collect",
                     lambda: jax.device_get(
@@ -1206,15 +1276,80 @@ class DispatchPlane:
         if fut.racer is not None:
             _race_crosscheck(fut.racer, out["valid?"])
             fut.racer = None
+        if fut.checkpoint is not None and "checkpoint" not in out:
+            self._checkpoint_finish(fut, out)
         fut._resolve(out)
+
+    def _checkpoint_replay(self, fut, steps, name, S, segs) -> bool:
+        """Bind a durable single-segment check to its sink at prep and
+        replay a finished verdict with ZERO launches (fut.kind="done").
+        Binding here computes the same content hash the sequential
+        checkpointed driver would, so replay/resume interchange freely
+        between the plane and `analyze --resume`. Returns True when the
+        future resolved from the checkpoint."""
+        from jepsen_tpu.checker import checkpoint as _cp
+
+        sink = fut.checkpoint
+        chash = _cp.steps_content_hash(steps, name, S, segs)
+        state = sink.begin(chash, segs, name, S)
+        v = state.get("verdict")
+        if v is None:
+            return False
+        alive, died = bool(v["alive"]), int(v["died"])
+        fr = sink.death_frontier_array()
+        if fr is not None:
+            steps._death_frontier = fr
+        out = {
+            "valid?": alive,
+            "method": "tpu-wgl-bitset",
+            "frontier_k": None,
+            "escalations": 0,
+            "checkpoint": sink.summary(),
+        }
+        if not alive:
+            out["failed_op_index"] = died
+            if fr is not None:
+                out["failure"] = bs.decode_frontier(
+                    fr, steps, died, fut.model,
+                    decode_value=_decode_value(fut.events),
+                )
+        fut.kind = "done"
+        fut._resolve(out)
+        return True
+
+    def _checkpoint_finish(self, fut: CheckFuture, out: dict) -> None:
+        """Record a durable coalesced check's verdict in its sink: for
+        single-segment durable plans begin() ran at prep and the
+        verdict just resolved off a shared bucket, so finish() makes it
+        replayable. Sinks that never began (streams outside the bitset
+        envelope) have nothing to record. Durability must never wedge
+        resolution: persistence failures leave the verdict intact."""
+        sink = fut.checkpoint
+        if getattr(sink, "_state", None) is None:
+            return
+        try:
+            fr = None
+            if out.get("valid?") is False and fut.steps is not None:
+                fr = getattr(fut.steps, "_death_frontier", None)
+            sink.finish(
+                alive=bool(out.get("valid?")),
+                taint=False,
+                died=int(out.get("failed_op_index", -1)),
+                death_frontier=fr,
+            )
+            out["checkpoint"] = sink.summary()
+        except Exception:  # noqa: BLE001 - verdict delivery wins
+            pass
 
     def _sequential_recheck(self, fut: CheckFuture) -> dict:
         """Full sequential re-check for a request whose batched verdict
         needs the solo path's artifacts (death reports) or tiers
-        (K-ladder escalation). Rare by construction."""
+        (K-ladder escalation). Rare by construction. Durable futures
+        hand their sink through so the definite verdict (and death
+        frontier) lands in the checkpoint."""
         return check_events_bucketed(
             fut.events, model=fut.kernel_model, race=False,
-            interpret=self.interpret,
+            interpret=self.interpret, checkpoint=fut.checkpoint,
         )
 
     def _resolve_bitset(self, launch: _Launch, host) -> None:
@@ -1292,8 +1427,15 @@ class DispatchPlane:
             if f.done():
                 continue
             try:
+                # Durable solos inherit the plane's race policy (race=
+                # None defers to eligibility): the sequential driver
+                # runs its own racer crosscheck after the device
+                # verdict. Plain fallbacks stay race=False — they are
+                # the oracle rung, there is nothing to crosscheck.
                 out = check_events_bucketed(
-                    f.events, model=f.model, race=False,
+                    f.events, model=f.model,
+                    race=(None if (self.race and f.checkpoint is not None)
+                          else False),
                     interpret=self.interpret,
                     checkpoint=f.checkpoint,
                 )

@@ -406,12 +406,14 @@ def check_events_bucketed(
     launch accounting, escalation) without a TPU.
 
     checkpoint: a checkpoint.CheckpointSink routes the bitset tier
-    through the durable segment-at-a-time driver (one launch per
-    segment, every verified boundary persisted, crash-safe resume —
-    see wgl_bitset.check_steps_bitset_segmented_checkpointed). The
-    racer is disabled for checkpointed checks (a native win would
-    leave no durable trail). Only the bitset envelope checkpoints;
-    out-of-envelope streams ignore the sink and run their usual path.
+    through the durable resident group driver (one launch and one host
+    sync per `every=N` persistence boundary, crash-safe resume — see
+    wgl_bitset.check_steps_bitset_segmented_checkpointed). The racer
+    runs as a post-verdict crosscheck for checkpointed checks: the
+    device verdict lands in the durable trail first, then the native
+    oracle must agree — a native "win" never races past persistence.
+    Only the bitset envelope checkpoints; out-of-envelope streams
+    ignore the sink and run their usual path.
     """
     from jepsen_tpu.checker.models import model as get_model
 
@@ -442,11 +444,22 @@ def check_events_bucketed(
                 check_steps_bitset_segmented,
             )
 
+            if race is None:
+                race = _race_eligible(events, m)
+            if race:
+                # Crosscheck, not competition: the racer starts before
+                # the (long) durable driver so the native scan overlaps
+                # device work, but its verdict is only COMPARED after
+                # the device verdict is durably recorded.
+                racer = _NativeRacer(events, model)
             alive, taint, died = check_steps_bitset_segmented(
                 bsteps, model=model, S=S, interpret=interpret,
                 checkpoint=checkpoint,
             )
             if not taint:
+                if racer is not None:
+                    _race_crosscheck(racer, alive)
+                    racer = None
                 out = {
                     "valid?": alive,
                     "method": "tpu-wgl-bitset",
@@ -478,7 +491,10 @@ def check_events_bucketed(
         if race:
             # Start AFTER the dispatch: host prep is done, the core is
             # otherwise idle while the device scans / the tunnel syncs.
-            racer = _NativeRacer(events, model)
+            # (A tainted checkpointed run falls through with its racer
+            # already live — reuse it rather than spawning a second.)
+            if racer is None:
+                racer = _NativeRacer(events, model)
             verdict = _race_decide(
                 events, bsteps, handle, racer, model
             )
@@ -1052,6 +1068,23 @@ class LinearizableChecker:
         out["wall_s"] = time.perf_counter() - t0
         self._render_failure(test, out, opts)
         return out
+
+    def check_streaming(self, path: Optional[str] = None):
+        """A streaming.StreamingCheck handle bound to this checker's
+        model/init_value/interpret config: append(ops) checks only the
+        new tail of the history (device-resident frontier), result()
+        yields the definite verdict. path persists the stream frontier
+        so a restarted process resumes instead of re-checking the
+        prefix — the `analyze --follow` and `POST /check/stream`
+        engine."""
+        from jepsen_tpu.checker.streaming import StreamingCheck
+
+        return StreamingCheck(
+            model=self.model,
+            init_value=self.init_value,
+            interpret=self.interpret,
+            path=path,
+        )
 
     @staticmethod
     def _render_failure(test, out, opts) -> None:

@@ -1,0 +1,444 @@
+"""Incremental (streaming) linearizability checking.
+
+A StreamingCheck turns the batch checker into something that can sit
+behind live traffic: ``append(ops)`` extends the history, re-encodes,
+and launches ONLY the unchecked tail of the step stream, chaining from
+the frontier bitset the previous launches left behind. The handle is
+what `cli.py analyze --follow` tails a growing history JSONL with, and
+what the service daemon's ``POST /check/stream`` route holds per
+(tenant, stream_id).
+
+Soundness rests on the same two invariants the checkpoint layer uses
+(checkpoint.py module docstring), plus prefix-closure:
+
+- A fast-tier ALIVE verdict is definite and the boundary frontier
+  equals the uninterrupted chain's, so an alive prefix's frontier is a
+  sound starting point for the tail.
+- A fast-tier DEATH is provisional: the handle escalates to the exact
+  tier STICKY and re-runs from step 0 (under-closure before a boundary
+  is never repaired downstream).
+- Linearizability is prefix-closed: once a prefix is invalid on the
+  exact tier, no suffix can revive it — invalid verdicts are terminal.
+
+Appending is NOT guaranteed to leave the encoded prefix byte-stable
+(a late completion can reclassify an earlier invoke, a new value code
+can widen the state space, a wider window can re-bucket W). Every
+append therefore re-encodes and compares a sha256 of the already-
+checked step rows against the one the frontier was computed under; any
+mismatch invalidates back to step 0 — never a stale frontier under a
+rewritten prefix. The same hash machinery makes the handle durable:
+with ``path`` set, each verified boundary persists atomically
+(store.atomic_write_text), and a new handle over the same path resumes
+from the saved frontier iff the saved prefix hash still matches.
+
+Histories outside the bitset envelope (no device, window overflow,
+non-kernel models) run DEFERRED: appends just accumulate and result()
+delegates to check_events_bucketed — identical verdicts, no
+incrementality.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from jepsen_tpu.checker import wgl_bitset as bs
+from jepsen_tpu.checker.checkpoint import (
+    _dec_arr,
+    _enc_arr,
+    _payload_sha,
+)
+from jepsen_tpu.checker.events import (
+    WindowOverflow,
+    events_to_steps,
+    history_to_events,
+)
+from jepsen_tpu.checker.models import model as get_model
+
+#: bump when the persisted stream-state layout changes
+VERSION = 1
+
+#: streaming accounting, same lock discipline as LAUNCH_STATS:
+#: appends = append() calls, tail_launches = device chains over fresh
+#: tails, tail_steps = step rows those chains covered, invalidations =
+#: prefix rewrites that forced a restart from step 0, resumes = handles
+#: re-attached to a persisted frontier, escalations = fast->exact
+#: restarts, deferred = appends routed outside the bitset envelope.
+STREAM_STATS = {
+    "appends": 0,
+    "tail_launches": 0,
+    "tail_steps": 0,
+    "invalidations": 0,
+    "resumes": 0,
+    "escalations": 0,
+    "deferred": 0,
+}
+
+_stats_lock = threading.Lock()
+
+
+def _bump(key: str, n=1) -> None:
+    with _stats_lock:
+        STREAM_STATS[key] += n
+
+
+def reset_stream_stats() -> None:
+    with _stats_lock:
+        for k in STREAM_STATS:
+            STREAM_STATS[k] = 0
+
+
+def stream_stats() -> dict:
+    with _stats_lock:
+        return dict(STREAM_STATS)
+
+
+def _prefix_sha(steps, n: int, model: str, S: int) -> str:
+    """sha256 over the first n prepped step rows + the envelope header.
+    The frontier a chain leaves at row n is valid for a later check
+    exactly when this hash matches: same rows, same W bucket, same
+    state-row count, same init state."""
+    h = hashlib.sha256()
+    h.update(
+        f"v{VERSION}|{model}|S{S}|W{steps.W}|"
+        f"init{steps.init_state}|n{n}|".encode()
+    )
+    for arr in (
+        steps.occ[:n], steps.f[:n], steps.a[:n], steps.b[:n],
+        steps.slot[:n], steps.live[:n], steps.crashed[:n],
+        steps.op_index[:n],
+    ):
+        h.update(np.ascontiguousarray(arr).tobytes())
+    if steps.fresh is not None:
+        h.update(np.ascontiguousarray(steps.fresh[:n]).tobytes())
+    return h.hexdigest()
+
+
+class StreamingCheck:
+    """Incremental linearizability check over a growing history.
+
+    append(ops) -> status dict with a PROVISIONAL "valid?" (True while
+    every checked step is alive, False once dead — terminal, None while
+    deferred); result() -> the full verdict dict, same shape as
+    check_events_bucketed's.
+
+    model/init_value/interpret: as LinearizableChecker. path: a file
+    (or directory) to persist the stream frontier into after each
+    verified append — a later handle over the same path resumes instead
+    of re-checking the prefix (SIGKILL-safe: atomic writes only).
+    """
+
+    def __init__(
+        self,
+        model: str = "cas-register",
+        init_value: Any = None,
+        interpret: bool = False,
+        path: Optional[str] = None,
+    ):
+        import os
+
+        if path is not None and os.path.isdir(path):
+            path = os.path.join(path, "stream.json")
+        if path is not None:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+        self.model = model
+        self.init_value = init_value
+        self.interpret = interpret
+        self.path = path
+        self._ops: List[dict] = []
+        self._events = None
+        self._steps = None
+        self._checked = 0          # step rows verified so far
+        self._sha: Optional[str] = None
+        self._frontier: Optional[np.ndarray] = None  # [1, S, M] host
+        self._exact = False        # sticky fast->exact escalation
+        self._deferred = False     # outside the bitset envelope
+        self._verdict: Optional[dict] = None  # terminal (invalid)
+        self._S = 0
+        self._W = 0
+        self.resumed = False
+        self._saved = self._load() if path else None
+
+    # -- persistence ---------------------------------------------------
+
+    def _load(self) -> Optional[dict]:
+        try:
+            with open(self.path) as f:
+                st = json.load(f)
+        except (OSError, ValueError):
+            return None
+        try:
+            ok = (
+                st.get("version") == VERSION
+                and st.get("model") == self.model
+                and st.get("payload_sha") == _payload_sha(st)
+            )
+        except (TypeError, ValueError):
+            ok = False
+        return st if ok else None
+
+    def _save(self) -> None:
+        if self.path is None:
+            return
+        from jepsen_tpu.store import atomic_write_text
+
+        st = {
+            "version": VERSION,
+            "model": self.model,
+            "S": self._S,
+            "W": self._W,
+            "checked": self._checked,
+            "prefix_sha": self._sha,
+            "exact": self._exact,
+            "frontier": (
+                _enc_arr(self._frontier)
+                if self._frontier is not None
+                else None
+            ),
+        }
+        st["payload_sha"] = _payload_sha(st)
+        atomic_write_text(self.path, json.dumps(st))
+
+    def _try_resume(self, steps, S: int) -> None:
+        """Adopt a persisted frontier iff its prefix hash matches the
+        CURRENT encoding of those rows (stale or torn state rejects to
+        a cold run — same discipline as CheckpointSink._load)."""
+        st, self._saved = self._saved, None
+        if not st or st.get("frontier") is None:
+            return
+        n = int(st.get("checked", 0))
+        if (
+            n <= 0
+            or n > len(steps)
+            or int(st.get("S", -1)) != S
+            or int(st.get("W", -1)) != steps.W
+            or st.get("prefix_sha") != _prefix_sha(steps, n, self.model, S)
+        ):
+            return
+        self._checked = n
+        self._sha = st["prefix_sha"]
+        self._frontier = _dec_arr(st["frontier"])
+        self._exact = bool(st.get("exact", False))
+        # adopt the validated envelope too, or _advance's rewrite
+        # guard would see a stale S/W and void the resume immediately
+        self._S, self._W = S, steps.W
+        self.resumed = True
+        _bump("resumes")
+
+    # -- the incremental engine ----------------------------------------
+
+    def append(self, ops) -> dict:
+        """Extend the history and check the new tail. Returns the
+        provisional status (see class docstring). Invalid is terminal:
+        further appends return the recorded verdict unchanged
+        (linearizability is prefix-closed)."""
+        _bump("appends")
+        if self._verdict is not None:
+            return self.status()
+        self._ops.extend(ops)
+        self._advance()
+        return self.status()
+
+    def status(self) -> dict:
+        """The current provisional status without touching the device."""
+        if self._verdict is not None:
+            out = dict(self._verdict)
+        else:
+            out = {
+                "valid?": None if self._deferred else True,
+                "deferred": self._deferred,
+            }
+        out["n_ops"] = len(self._ops)
+        out["checked_steps"] = self._checked
+        out["exact"] = self._exact
+        return out
+
+    def _encode(self):
+        """(events, steps, S) for the CURRENT history, or None when the
+        stream is outside the bitset envelope (deferred mode)."""
+        from jepsen_tpu.checker.linearizable import _on_tpu
+        from jepsen_tpu.history.history import History
+
+        try:
+            ev = history_to_events(
+                History(self._ops), model=self.model,
+                init_value=self.init_value,
+            )
+        except WindowOverflow:
+            return None
+        self._events = ev
+        if not (_on_tpu() or self.interpret):
+            return None
+        m = get_model(self.model)
+        plan = bs.plan(m, ev.window, len(ev.value_codes))
+        if plan is None:
+            return None
+        bW, S = plan
+        return ev, events_to_steps(ev, W=bW), S
+
+    def _advance(self) -> None:
+        if not self._ops:
+            return
+        enc = self._encode()
+        if enc is None:
+            if not self._deferred:
+                self._deferred = True
+            _bump("deferred")
+            return
+        ev, steps, S = enc
+        self._deferred = False
+        if self._saved is not None and self._checked == 0:
+            self._try_resume(steps, S)
+        if self._checked > 0 and (
+            S != self._S
+            or steps.W != self._W
+            or self._sha != _prefix_sha(
+                steps, min(self._checked, len(steps)), self.model, S
+            )
+        ):
+            # The prefix we certified no longer exists in this encoding
+            # (late completion, new value code, wider window): the
+            # frontier is for a different stream. Restart cold — and
+            # drop the sticky exact tier with it, a rewritten history
+            # has not yet earned an escalation.
+            _bump("invalidations")
+            self._checked = 0
+            self._frontier = None
+            self._sha = None
+            self._exact = False
+        self._steps, self._S, self._W = steps, S, steps.W
+        name = self.model if isinstance(self.model, str) else self.model.name
+        while self._checked < len(steps):
+            tail = bs._slice_steps(steps, self._checked, len(steps), steps.W)
+            segs = bs.plan_segments(tail)
+            args = bs._segment_args(tail, segs)
+            seg_ws = tuple(W for _, _, W in segs)
+            fr_host = self._frontier
+            if fr_host is None:
+                fr_host = bs.init_frontier(
+                    steps.init_state, S, segs[0][2]
+                )[None]
+            bs._bump_launch("launches")
+            _bump("tail_launches")
+            _bump("tail_steps", len(tail))
+            outs, frs, _ = bs._run_chain(
+                args, jnp.asarray(fr_host), seg_ws, name, S,
+                self.interpret, self._exact,
+            )
+            # ONE host sync per append: every tail segment's verdict
+            # row plus the boundary frontier in a single fetch.
+            o_host, fr_last = bs._host_get((tuple(outs), frs[-1]))
+            died_seg, died = -1, -1
+            taint = False
+            for gi, o in enumerate(o_host):
+                alive, t, d = bs._out_to_verdicts(np.asarray(o))[0]
+                taint = taint or t
+                if not alive:
+                    died_seg, died = gi, d
+                    break  # first death wins; downstream is garbage
+            if taint:
+                # Out of the kernel's certainty envelope: stop growing
+                # frontiers and let result() decide via the full
+                # bucketed ladder. (Unreachable for bitset plans by
+                # construction — belt and braces.)
+                self._deferred = True
+                _bump("deferred")
+                return
+            if died_seg >= 0:
+                if not self._exact:
+                    # Provisional fast death: escalate STICKY and
+                    # restart the whole stream on the exact tier.
+                    bs._bump_launch("escalations")
+                    _bump("escalations")
+                    self._exact = True
+                    self._checked = 0
+                    self._frontier = None
+                    self._sha = None
+                    continue
+                self._record_death(steps, frs, died_seg, died)
+                return
+            self._frontier = np.asarray(fr_last)
+            self._checked = len(steps)
+            self._sha = _prefix_sha(steps, self._checked, self.model, S)
+            self._save()
+
+    def _record_death(self, steps, frs, died_seg: int, died: int) -> None:
+        """Terminal invalid verdict with the standard failure report
+        (decode_frontier over the dying segment's pre-filter
+        frontier)."""
+        import jax
+
+        from jepsen_tpu.checker.linearizable import _decode_value
+
+        fr = np.asarray(jax.device_get(frs[died_seg]))[0]
+        steps._death_frontier = fr
+        out = {
+            "valid?": False,
+            "method": "tpu-wgl-bitset-streaming",
+            "frontier_k": None,
+            "escalations": int(self._exact),
+            "failed_op_index": died,
+            "failure": bs.decode_frontier(
+                fr, steps, died, self.model,
+                decode_value=_decode_value(self._events),
+            ),
+        }
+        self._verdict = out
+        self._save()
+
+    # -- final verdict -------------------------------------------------
+
+    def result(self) -> dict:
+        """The definite verdict over everything appended so far. For
+        deferred streams this is one full check_events_bucketed run;
+        for incremental streams every step is already verified and no
+        device work remains."""
+        if self._verdict is not None:
+            out = dict(self._verdict)
+        elif self._deferred or self._events is None:
+            out = self._deferred_result()
+        else:
+            out = {
+                "valid?": True,
+                "method": "tpu-wgl-bitset-streaming",
+                "frontier_k": None,
+                "escalations": int(self._exact),
+            }
+        out["n_ops"] = len(self._ops)
+        out.setdefault("streaming", self.summary())
+        return out
+
+    def _deferred_result(self) -> dict:
+        from jepsen_tpu.checker.linearizable import check_events_bucketed
+        from jepsen_tpu.history.history import History
+
+        if not self._ops:
+            return {"valid?": True, "method": "empty-history",
+                    "frontier_k": None, "escalations": 0}
+        ev = self._events
+        if ev is None:
+            ev = history_to_events(
+                History(self._ops), model=self.model,
+                init_value=self.init_value, max_window=1 << 20,
+            )
+        return check_events_bucketed(
+            ev, model=self.model, interpret=self.interpret,
+        )
+
+    def summary(self) -> Dict[str, Any]:
+        """Per-stream block for results/service responses."""
+        return {
+            "checked_steps": self._checked,
+            "exact": self._exact,
+            "deferred": self._deferred,
+            "resumed": self.resumed,
+            "path": self.path,
+        }

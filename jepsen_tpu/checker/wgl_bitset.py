@@ -389,7 +389,19 @@ def bitset_words(W: int) -> int:
 #: through _bump_launch: the dispatch plane's prep worker and
 #: collecting callers launch concurrently, and unlocked += would drop
 #: counts under the interleaving.
-LAUNCH_STATS = {"launches": 0, "escalations": 0}
+LAUNCH_STATS = {
+    "launches": 0,
+    "escalations": 0,
+    # host_syncs: device->host fetches that pay the tunnel round trip
+    # (every fetch goes through _host_get). The residency contract is
+    # host_syncs == 1 per segmented check, however many segments the
+    # plan chains; bench publishes host_syncs/checks as syncs_per_check.
+    "host_syncs": 0,
+    # donated_buffers: chain launches whose input frontier buffer was
+    # donated to the computation (resident backends only — see
+    # sharded.residency_supported).
+    "donated_buffers": 0,
+}
 
 _launch_stats_lock = threading.Lock()
 
@@ -403,6 +415,19 @@ def reset_launch_stats() -> None:
     with _launch_stats_lock:
         LAUNCH_STATS["launches"] = 0
         LAUNCH_STATS["escalations"] = 0
+        LAUNCH_STATS["host_syncs"] = 0
+        LAUNCH_STATS["donated_buffers"] = 0
+
+
+def _host_get(x):
+    """THE device->host fetch. Every sync that pays the tunnel round
+    trip funnels through here so LAUNCH_STATS["host_syncs"] counts
+    exactly the sync-floor payments a check makes (one _host_get call =
+    one sync, whatever pytree it pulls). Follow-up fetches of arrays
+    the same computation already materialized (death artifacts, debug
+    frontiers) use plain device_get/np.asarray — the floor was paid."""
+    _bump_launch("host_syncs")
+    return jax.device_get(x)
 
 
 def init_frontier(init_state, S: int, W: int) -> np.ndarray:
@@ -483,11 +508,7 @@ def _bitset_scan(
     )(win, meta, fr_in)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("seg_ws", "model_name", "S", "interpret", "exact"),
-)
-def _chain_scan(args, fr0, seg_ws, model_name, S, interpret, exact):
+def _chain_scan_impl(args, fr0, seg_ws, model_name, S, interpret, exact):
     """Whole-plan segment chain in ONE jitted computation -> one host
     dispatch. `args` is the flat (win0, meta0, win1, meta1, ...) tuple
     of packed device args, seg_ws the per-segment W buckets (static —
@@ -513,6 +534,39 @@ def _chain_scan(args, fr0, seg_ws, model_name, S, interpret, exact):
         outs.append(out)
         frs.append(fr)
     return tuple(outs), tuple(frs), tuple(fr_ins)
+
+
+_CHAIN_STATIC = ("seg_ws", "model_name", "S", "interpret", "exact")
+
+_chain_scan = functools.partial(
+    jax.jit, static_argnames=_CHAIN_STATIC
+)(_chain_scan_impl)
+
+#: Resident twin: fr0 (positional arg 1) is DONATED, so the input
+#: frontier's device buffer aliases the chain's frontier outputs in
+#: place — between launches the frontier never allocates fresh HBM and
+#: never visits the host. Callers hand over ownership: a donated fr0
+#: must be freshly built per launch (every call site does). Only
+#: dispatched when sharded.residency_supported() — XLA:CPU ignores
+#: donation with a warning per call, and tier-1 must stay warning-clean.
+_chain_scan_donated = functools.partial(
+    jax.jit, static_argnames=_CHAIN_STATIC, donate_argnums=(1,)
+)(_chain_scan_impl)
+
+
+def _run_chain(args, fr0, seg_ws, model_name, S, interpret, exact):
+    """Dispatch one whole-plan chain, picking the donating variant when
+    the backend actually honors input-output aliasing."""
+    from jepsen_tpu.checker.sharded import residency_supported
+
+    if residency_supported():
+        _bump_launch("donated_buffers")
+        return _chain_scan_donated(
+            args, fr0, seg_ws, model_name, S, interpret, exact
+        )
+    return _chain_scan(
+        args, fr0, seg_ws, model_name, S, interpret, exact
+    )
 
 
 def pack_steps(steps: ReturnSteps):
@@ -584,12 +638,12 @@ def check_steps_bitset(
         )
 
     out, fr = scan(exact)
-    verdict = _out_to_verdicts(np.asarray(out))[0]
+    verdict = _out_to_verdicts(_host_get(out))[0]
     if not verdict[0] and not exact:
         # fast-tier death is provisional (under-closure): exact decides
         _bump_launch("escalations")
         out, fr = scan(True)
-        verdict = _out_to_verdicts(np.asarray(out))[0]
+        verdict = _out_to_verdicts(_host_get(out))[0]
     if not verdict[0]:
         # death artifact: the pre-filter frontier (decode_frontier)
         steps._death_frontier = np.asarray(fr)[0]
@@ -813,7 +867,7 @@ def launch_steps_bitset_segmented(
         fr0 = jax.device_put(fr0, device)
     seg_ws = tuple(W for _, _, W in segs)
     _bump_launch("launches")
-    outs, frs, fr_ins = _chain_scan(
+    outs, frs, fr_ins = _run_chain(
         args, fr0, seg_ws, name, S, interpret, exact
     )
     return list(outs), list(frs), (
@@ -841,7 +895,7 @@ def collect_steps_bitset_segmented(
     sync here."""
     outs, frs, (segs, fr_ins, name, S, interpret, exact) = handle
     fetched = (
-        jax.device_get(tuple(outs)) if outs_host is None else outs_host
+        _host_get(tuple(outs)) if outs_host is None else outs_host
     )
     taint = False
     for k, (o, dead_fr) in enumerate(zip(fetched, frs)):
@@ -864,12 +918,12 @@ def collect_steps_bitset_segmented(
             from jepsen_tpu.checker import chaos
 
             outs2, frs2, _ = chaos.resilient_call(
-                lambda: _chain_scan(
+                lambda: _run_chain(
                     args, fr0, seg_ws, name, S, interpret, True
                 ),
                 site="launch",
             )
-            for o2, f2 in zip(jax.device_get(tuple(outs2)), frs2):
+            for o2, f2 in zip(_host_get(tuple(outs2)), frs2):
                 alive2, t2, died2 = _out_to_verdicts(np.asarray(o2))[0]
                 taint = taint or t2
                 if not alive2:
@@ -887,14 +941,18 @@ def check_steps_bitset_segmented_checkpointed(
     interpret: bool = False,
     min_len: int | None = None,
 ) -> Tuple[bool, bool, int]:
-    """Durable segment-at-a-time variant of the segmented scan: each
-    segment dispatches on its own (one launch per segment — the price
-    of a durable boundary is a host sync, which is why this path is
-    opt-in via a checkpoint.CheckpointSink), and every verified
-    boundary's frontier persists atomically before the next segment
-    starts. A killed process re-enters at the last durable frontier
-    and re-runs only unverified segments; a finished checkpoint
-    replays its verdict with ZERO launches.
+    """Durable RESIDENT variant of the segmented scan: segments chain
+    on device in boundary groups — every `sink.every` segments form ONE
+    launch (`_run_chain`, frontier donated on resident backends), and
+    the frontier only visits the host at the persistence boundary that
+    ends the group, where it checkpoints atomically before the next
+    group starts. With every=1 (the default) that degenerates to one
+    launch + one durable boundary per segment — the maximally
+    crash-granular schedule; with every >= len(plan) the whole durable
+    check pays ONE host sync, same as the plain segmented path. A
+    killed process re-enters at the last durable frontier and re-runs
+    only unverified groups; a finished checkpoint replays its verdict
+    with ZERO launches.
 
     Soundness: a fast-tier boundary frontier equals the uninterrupted
     chain's (same kernels, same inputs), and fast ALIVE verdicts are
@@ -923,6 +981,7 @@ def check_steps_bitset_segmented_checkpointed(
     start = int(state.get("segments_done", 0))
     fr_host = sink.frontier_array()
     taint = False
+    group_n = max(int(getattr(sink, "every", 1)), 1)
     while True:  # one iteration per tier; escalation restarts the loop
         if start == 0 or fr_host is None:
             start = 0
@@ -930,29 +989,37 @@ def check_steps_bitset_segmented_checkpointed(
         k = start
         escalated = False
         while k < len(segs):
-            seg = segs[k]
-            args = _segment_args(steps, [seg])
+            g = min(k + group_n, len(segs))
+            group = segs[k:g]
+            args = _segment_args(steps, group)
             fr0 = jnp.asarray(fr_host)
+            seg_ws = tuple(W for _, _, W in group)
             _bump_launch("launches")
             run_exact = exact
 
-            def one_segment(a=args, f=fr0, W=seg[2], ex=run_exact):
-                outs, frs, _ = _chain_scan(
-                    a, f, (W,), name, S, interpret, ex
+            def one_group(a=args, f=fr0, ws=seg_ws, ex=run_exact):
+                outs, frs, _ = _run_chain(
+                    a, f, ws, name, S, interpret, ex
                 )
-                return (
-                    jax.device_get(outs[0]), jax.device_get(frs[0])
-                )
+                # ONE host sync per durable boundary: every group
+                # verdict row + the boundary frontier in a single
+                # fetch; the per-segment frontiers stay on device
+                # (only a terminal death pulls one more, below).
+                o_h, fr_h = _host_get((tuple(outs), frs[-1]))
+                return o_h, fr_h, frs
             # Same chaos seam as the plain collect path: transient
             # faults retry, exhaustion raises PlaneFault upward.
-            o_host, fr_host = chaos.resilient_call(
-                one_segment, site="launch"
+            o_host, fr_last, frs = chaos.resilient_call(
+                one_group, site="launch"
             )
-            o_host = np.asarray(o_host)
-            fr_host = np.asarray(fr_host)
-            alive, t, died = _out_to_verdicts(o_host)[0]
-            taint = taint or t
-            if not alive:
+            died_seg, died = -1, -1
+            for gi, o in enumerate(o_host):
+                alive, t, d = _out_to_verdicts(np.asarray(o))[0]
+                taint = taint or t
+                if not alive:
+                    died_seg, died = gi, d
+                    break  # first death wins; downstream is garbage
+            if died_seg >= 0:
                 if not exact:
                     # Provisional fast death: every fast checkpoint is
                     # void — durably escalate, restart from segment 0.
@@ -962,13 +1029,15 @@ def check_steps_bitset_segmented_checkpointed(
                     fr_host = None
                     escalated = True
                     break
-                steps._death_frontier = fr_host[0]
+                death_fr = np.asarray(jax.device_get(frs[died_seg]))[0]
+                steps._death_frontier = death_fr
                 sink.finish(
                     alive=False, taint=taint, died=died,
-                    death_frontier=fr_host[0],
+                    death_frontier=death_fr,
                 )
                 return False, taint, died
-            k += 1
+            fr_host = np.asarray(fr_last)
+            k = g
             sink.record(segments_done=k, frontier=fr_host, exact=exact)
         if escalated:
             start = 0
@@ -993,8 +1062,9 @@ def check_steps_bitset_segmented(
     segment's verdict in one device_get; the first death wins.
 
     checkpoint: a checkpoint.CheckpointSink switches to the durable
-    segment-at-a-time driver (one launch per segment, every boundary
-    persisted — see check_steps_bitset_segmented_checkpointed)."""
+    boundary-group driver (one launch and one host sync per `every`-
+    segment persistence group, so every=len(plan) matches this path's
+    single sync — see check_steps_bitset_segmented_checkpointed)."""
     if checkpoint is not None:
         return check_steps_bitset_segmented_checkpointed(
             steps, checkpoint, model=model, S=S, interpret=interpret,
@@ -1208,7 +1278,7 @@ def collect_keys_bitset(handle, out_host=None) -> List[Tuple[bool, bool, int]]:
         win_j, meta_j, fr0, name, S, W, interpret, exact, mesh, n_real
     ) = handle
     verdicts = _out_to_verdicts(
-        np.asarray(out if out_host is None else out_host)
+        np.asarray(_host_get(out) if out_host is None else out_host)
     )[:n_real]
     if exact or all(v[0] for v in verdicts):
         return verdicts
@@ -1245,7 +1315,7 @@ def collect_keys_bitset(handle, out_host=None) -> List[Tuple[bool, bool, int]]:
             ),
             site="launch",
         )
-    return _out_to_verdicts(np.asarray(out2))[:n_real]
+    return _out_to_verdicts(np.asarray(_host_get(out2)))[:n_real]
 
 
 def check_keys_bitset(

@@ -140,11 +140,13 @@ def _reset_engine_state() -> None:
     from jepsen_tpu.checker import chaos, dispatch
     from jepsen_tpu.checker import wgl_bitset as bs
     from jepsen_tpu.checker.checkpoint import reset_checkpoint_stats
+    from jepsen_tpu.checker.streaming import reset_stream_stats
 
     chaos.reset_resilience()
     dispatch.reset_default_plane()
     bs.reset_launch_stats()
     reset_checkpoint_stats()
+    reset_stream_stats()
 
 
 def cmd_test(args) -> int:
@@ -213,7 +215,14 @@ def cmd_analyze(args) -> int:
     tampered checkpoints are rejected and the check runs cold).
     engine_stats in results.json carries the launch + checkpoint
     accounting so a resumed run's strictly-fewer launches are
-    auditable."""
+    auditable.
+
+    --follow: tail a GROWING history.jsonl with the streaming checker
+    instead of loading it once — each poll appends the newly written
+    ops and launches only that tail (checker/streaming.py). Combine
+    with --resume to persist the stream frontier into
+    <run_dir>/stream.json so a restarted --follow skips the already-
+    checked prefix."""
     import os
 
     from jepsen_tpu.history.sentry import (
@@ -224,6 +233,8 @@ def cmd_analyze(args) -> int:
 
     _reset_engine_state()
     run_dir = _resolve_run_dir(args.path, args.store)
+    if args.follow:
+        return _analyze_follow(args, run_dir)
     st = Store(args.store)
     history = st.load_history(run_dir)
     test = st.load_test(run_dir)
@@ -272,6 +283,71 @@ def cmd_analyze(args) -> int:
     return _exit_code(results)
 
 
+def _analyze_follow(args, run_dir: str) -> int:
+    """`analyze --follow`: tail <run_dir>/history.jsonl with a
+    StreamingCheck. Each poll reads the complete lines written since
+    the last one, appends them, and checks only that tail; the follow
+    ends after --follow-idle seconds without growth, or immediately at
+    an invalid verdict (terminal — linearizability is prefix-closed).
+    The sentry gate is skipped while following (a live history always
+    has unpaired tails); run a plain `analyze` afterwards for the
+    sentry report. Register (linearizable) workloads only."""
+    import json as _json
+    import os
+    import time as _time
+
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+    from jepsen_tpu.store import op_from_json
+
+    if args.workload not in (None, "register"):
+        print(f"--follow supports only the register (linearizable) "
+              f"workload, not {args.workload!r}")
+        return EXIT_USAGE
+    interp = os.environ.get("JEPSEN_TPU_INTERPRET", "") not in ("", "0")
+    checker = LinearizableChecker(interpret=interp)
+    sc = checker.check_streaming(
+        path=os.path.join(run_dir, "stream.json") if args.resume else None
+    )
+    hist = os.path.join(run_dir, "history.jsonl")
+    pos = 0
+    idle_s = max(float(args.follow_idle), 0.0)
+    last_growth = _time.monotonic()
+    while True:
+        batch = []
+        try:
+            with open(hist, "rb") as f:
+                f.seek(pos)
+                for raw in f:
+                    if not raw.endswith(b"\n"):
+                        break  # torn tail write: retry next poll
+                    pos += len(raw)
+                    line = raw.decode().strip()
+                    if line:
+                        batch.append(op_from_json(_json.loads(line)))
+        except FileNotFoundError:
+            pass  # appears on the writer's first atomic rename
+        if batch:
+            status = sc.append(batch)
+            last_growth = _time.monotonic()
+            print(f"followed +{len(batch)} ops "
+                  f"(checked_steps={status.get('checked_steps')}, "
+                  f"valid?={status.get('valid?')})")
+            if status.get("valid?") is False:
+                break
+        elif _time.monotonic() - last_growth >= idle_s:
+            break
+        else:
+            _time.sleep(min(0.2, idle_s) if idle_s else 0.2)
+    results = sc.result()
+    results["engine_stats"] = _engine_stats()
+    if args.stats_json:
+        _dump_stats_json(args.stats_json)
+    print(f"analyzed {run_dir} (followed): "
+          f"valid?={results.get('valid?')}")
+    print(_epitaph(_exit_code(results)))
+    return _exit_code(results)
+
+
 def _dump_stats_json(path: str) -> None:
     """Write the full engine-stats bundle — the same shape the daemon's
     /stats endpoint serves — to `path` ("-" = stdout). Scripts that
@@ -298,10 +374,12 @@ def _engine_stats() -> dict:
     resumed run shows strictly fewer launches than the cold one)."""
     from jepsen_tpu.checker import wgl_bitset as bs
     from jepsen_tpu.checker.checkpoint import checkpoint_stats
+    from jepsen_tpu.checker.streaming import stream_stats
 
     return {
         "launch": dict(bs.LAUNCH_STATS),
         "checkpoint": checkpoint_stats(),
+        "streaming": stream_stats(),
     }
 
 
@@ -405,6 +483,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="durable check: persist segment checkpoints "
                         "into the run dir and resume a killed "
                         "analysis at its last verified frontier")
+    a.add_argument("--follow", action="store_true",
+                   help="tail a growing history.jsonl and check "
+                        "incrementally (streaming checker; register "
+                        "workload only — combine with --resume to "
+                        "persist the stream frontier)")
+    a.add_argument("--follow-idle", type=float, default=2.0,
+                   metavar="SECONDS",
+                   help="stop following after this long with no new "
+                        "ops (default 2.0)")
     a.add_argument("--strict-history", action="store_true",
                    help="refuse (exit 3) instead of repairing when "
                         "the stored history fails sentry validation")

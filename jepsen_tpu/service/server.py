@@ -13,6 +13,14 @@ Endpoints::
 
     POST /check    {"model", "history": [op...], "durable", "strict",
                     "deadline_s", "init_value"}  (tenant: X-Tenant)
+    POST /check/stream
+                   {"stream_id", "ops": [op...], "final", "model",
+                    "init_value", "durable"} — chunked streaming
+                   check: each chunk appends to a per-(tenant,
+                   stream_id) StreamingCheck and launches only the new
+                   tail; non-final chunks answer 202 with provisional
+                   status, the final chunk answers 200 with the
+                   definite verdict
     GET  /stats    dispatch + launch + resilience + checkpoint +
                    tenant-ledger + admission snapshots
     GET  /healthz  liveness + drain state
@@ -173,6 +181,11 @@ class CheckerDaemon:
         )
         self.plane.fault_observer = self.ledger.observe_plane
         self.started_at = time.time()
+        #: live streaming checks, keyed (tenant, stream_id) — each
+        #: holds a checker/streaming.py StreamingCheck that chunked
+        #: POST /check/stream requests append into.
+        self._streams: dict = {}
+        self._streams_lock = threading.Lock()
         self._drained = threading.Event()
         handler = type(
             "Handler", (_Handler,), {"daemon_obj": self}
@@ -346,6 +359,79 @@ class CheckerDaemon:
         out["check_id"] = check_id
         return 200, out
 
+    def handle_stream(self, tenant: str, body: bytes) -> tuple:
+        """(status, response dict) for one chunk of a streaming check.
+
+        Request: {"stream_id": str, "ops": [op...], "final": bool,
+                  "model"?, "init_value"?, "durable"?}. Chunks append
+        into one per-(tenant, stream_id) StreamingCheck — only the new
+        tail of the step stream launches (checker/streaming.py).
+        Non-final chunks answer 202 with the provisional status; a
+        final chunk answers 200 with the definite verdict and drops
+        the handle. "durable" persists the stream frontier under the
+        service checkpoint root, so a daemon restart resumes the
+        stream when the client replays it from the start."""
+        from jepsen_tpu.checker.streaming import StreamingCheck
+
+        try:
+            req = json.loads(body)
+            stream_id = str(req.get("stream_id") or "").strip()
+            if not stream_id:
+                raise ValueError("stream_id is required")
+            ops = [op_from_json(d) for d in req.get("ops", [])]
+            final = bool(req.get("final"))
+        except Exception as e:  # noqa: BLE001 - malformed request
+            return 400, {"error": "bad-request", "detail": str(e)}
+        key = (tenant, stream_id)
+        with self._streams_lock:
+            sc = self._streams.get(key)
+            if sc is None:
+                path = None
+                if req.get("durable"):
+                    self.ledger.note(tenant, "durable_checks")
+                    path = self.store.service_checkpoint_path(
+                        tenant, "stream-" + stream_id
+                    ).replace("checkpoint.json", "stream.json")
+                sc = StreamingCheck(
+                    model=req.get("model", self.model),
+                    init_value=req.get("init_value"),
+                    interpret=self.interpret,
+                    path=path,
+                )
+                self._streams[key] = sc
+        try:
+            with dispatch.tenant_context(tenant):
+                # The handle is single-writer by lock: concurrent
+                # chunks of one stream serialize here; distinct
+                # streams proceed in parallel.
+                with self._streams_lock:
+                    status = sc.append(ops) if ops else sc.status()
+                    out = sc.result() if final else None
+        except Exception as e:  # noqa: BLE001 - the exit-2 analog
+            log.exception("stream chunk failed (tenant=%s)", tenant)
+            self.ledger.note(tenant, "errors")
+            with self._streams_lock:
+                self._streams.pop(key, None)
+            return 500, {"error": "check-failed", "detail": str(e)}
+        self.ledger.note(tenant, "stream_chunks")
+        if not final:
+            status = _jsonable(status)
+            status["tenant"] = tenant
+            status["stream_id"] = stream_id
+            return 202, status
+        with self._streams_lock:
+            self._streams.pop(key, None)
+        if sc.resumed:
+            self.ledger.note(tenant, "durable_resumes")
+        self.ledger.note(tenant, "completed")
+        self.ledger.note(
+            tenant, "valid" if out.get("valid?") else "invalid"
+        )
+        out = _jsonable(out)
+        out["tenant"] = tenant
+        out["stream_id"] = stream_id
+        return 200, out
+
 
 class _Handler(BaseHTTPRequestHandler):
     daemon_obj: CheckerDaemon  # bound by CheckerDaemon.__init__
@@ -381,7 +467,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(404, {"error": "not-found"})
 
     def do_POST(self):  # noqa: N802 (stdlib API)
-        if self.path != "/check":
+        if self.path not in ("/check", "/check/stream"):
             self._send_json(404, {"error": "not-found"})
             return
         d = self.daemon_obj
@@ -399,7 +485,10 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             body = self.rfile.read(int(cl))
-            status, obj = d.handle_check(tenant, body)
+            if self.path == "/check/stream":
+                status, obj = d.handle_stream(tenant, body)
+            else:
+                status, obj = d.handle_check(tenant, body)
         except Exception as e:  # noqa: BLE001 - last-resort envelope
             log.exception("unhandled service error")
             status, obj = 500, {

@@ -49,6 +49,7 @@ _ROW = {
     "durable_checks": 0,
     "durable_resumes": 0,     # resumed past segment 0 on resubmit
     "durable_replays": 0,     # finished checkpoint answered launch-free
+    "stream_chunks": 0,       # POST /check/stream chunks appended
 }
 
 

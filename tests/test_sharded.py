@@ -86,6 +86,9 @@ def test_graft_entry_contract(capfd):
     assert rec["value"] > 0
     assert rec["scaling_efficiency"] >= 0.6
     assert rec["mesh_wall_s"] > 0 and rec["single_wall_s"] > 0
+    # Device residency rides the metric line: a timed whole-batch
+    # check pays the tunnel sync floor exactly once.
+    assert rec["syncs_per_check"] == 1.0
     # Resilience accounting rides the same line: a clean dryrun
     # publishes integer zeros (nonzero means faults were survived).
     assert isinstance(rec["retries"], int) and rec["retries"] >= 0

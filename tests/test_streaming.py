@@ -1,0 +1,505 @@
+"""Device-resident streaming checker tests (checker/streaming.py + the
+resident segment chains in wgl_bitset.py).
+
+The contract under test, per the round-8 residency work:
+
+- a multi-segment check is ONE device launch and ONE host sync
+  (LAUNCH_STATS-pinned), plain and checkpointed alike;
+- forcing the donating chain variant on (residency_supported
+  monkeypatched) changes launch accounting, never verdicts;
+- an append-driven incremental check reaches exactly the verdict of a
+  one-shot check over the same history, valid and invalid;
+- a killed stream resumes from its persisted frontier with strictly
+  less tail work and an identical verdict (in-process drop in tier-1,
+  real SIGKILL subprocess in the slow tier).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import warnings
+
+import pytest
+
+from jepsen_tpu.checker import wgl_bitset as bs
+from jepsen_tpu.checker.checkpoint import CheckpointSink
+from jepsen_tpu.checker.events import events_to_steps, history_to_events
+from jepsen_tpu.checker.linearizable import (
+    LinearizableChecker,
+    check_events_bucketed,
+)
+from jepsen_tpu.checker.streaming import (
+    StreamingCheck,
+    reset_stream_stats,
+    stream_stats,
+)
+from jepsen_tpu.history.history import History
+from jepsen_tpu.history.ops import invoke_op, ok_op
+from jepsen_tpu.store import op_from_json, op_to_json
+
+pytestmark = pytest.mark.streaming
+
+
+@pytest.fixture
+def small_w(monkeypatch):
+    """Same speed seam as test_checkpoint: narrow W buckets so burst
+    histories segment at W4/W5 instead of W12/W13 in tier-1."""
+    monkeypatch.setattr(bs, "W_BUCKETS", (4, 5) + bs.W_BUCKETS)
+
+
+def burst_history(rounds=1, pairs=30, bad_tail=False, nburst=5):
+    """test_checkpoint's multi-segment recipe: sequential write pairs
+    (window 1) alternating with an nburst-process concurrent burst
+    (window nburst), so min_len=1 plans several segments across W
+    buckets. bad_tail appends a read of a never-written value."""
+    ops = []
+    for _ in range(rounds):
+        for i in range(pairs):
+            ops.append(invoke_op(0, "write", i % 3))
+            ops.append(ok_op(0, "write", i % 3))
+        for p in range(nburst):
+            ops.append(invoke_op(p, "write", p % 3))
+        for p in range(nburst):
+            ops.append(ok_op(p, "write", p % 3))
+    if bad_tail:
+        ops.append(invoke_op(0, "read"))
+        ops.append(ok_op(0, "read", 7))
+    return History(ops)
+
+
+def _bad_read_tail():
+    """A deterministically-invalid tail built ONLY from values the
+    stream has already seen: two sequential reads on one process that
+    observe different values with no write in between. Unlike
+    bad_tail's never-written 7, this adds no value code and no window
+    growth, so the encoded prefix stays byte-stable — the shape a
+    resumed stream must survive."""
+    return [
+        invoke_op(0, "read"), ok_op(0, "read", 0),
+        invoke_op(0, "read"), ok_op(0, "read", 1),
+    ]
+
+
+def _steps(h):
+    ev = history_to_events(h, model="cas-register")
+    return events_to_steps(ev, W=ev.window)
+
+
+def _oneshot(h):
+    ev = history_to_events(h, model="cas-register")
+    return check_events_bucketed(
+        ev, model="cas-register", interpret=True, race=False
+    )
+
+
+def _verdict_fields(out):
+    return {k: out.get(k) for k in ("valid?", "failed_op_index")}
+
+
+# -- the sync-floor pins (ISSUE acceptance: 1 host sync per check) ----
+
+
+def test_segmented_chain_is_one_launch_one_sync(small_w):
+    steps = _steps(burst_history())
+    assert len(bs.plan_segments(steps, min_len=1)) >= 2
+    bs.reset_launch_stats()
+    v = bs.check_steps_bitset_segmented(
+        steps, model="cas-register", S=8, interpret=True, min_len=1
+    )
+    assert v == (True, False, -1)
+    assert bs.LAUNCH_STATS["launches"] == 1
+    assert bs.LAUNCH_STATS["host_syncs"] == 1
+
+
+def test_checkpointed_group_chain_is_one_launch_one_sync(
+    tmp_path, small_w
+):
+    """every >= len(plan) puts the whole durable check in one boundary
+    group: the sync floor matches the plain chain's (exactly 1), and
+    the verdict is identical."""
+    h = burst_history()
+    steps = _steps(h)
+    segs = bs.plan_segments(steps, min_len=1)
+    plain = bs.check_steps_bitset_segmented(
+        _steps(h), model="cas-register", S=8, interpret=True, min_len=1
+    )
+    bs.reset_launch_stats()
+    sink = CheckpointSink(str(tmp_path), seg_min_len=1, every=len(segs))
+    v = bs.check_steps_bitset_segmented(
+        steps, model="cas-register", S=8, interpret=True,
+        checkpoint=sink,
+    )
+    assert v == plain == (True, False, -1)
+    assert bs.LAUNCH_STATS["launches"] == 1
+    assert bs.LAUNCH_STATS["host_syncs"] == 1
+    # the single boundary group still left a durable trail
+    assert sink.summary()["segments_total"] == len(segs)
+    assert os.path.exists(os.path.join(str(tmp_path), "checkpoint.json"))
+
+
+# -- donation differential (satellite: forced-residency parity) -------
+
+
+def test_forced_residency_donation_differential(small_w, monkeypatch):
+    """Force the donating chain variant on (CPU ignores donation with
+    a warning, suppressed here): donated_buffers accounting engages,
+    the sync pin holds, and verdicts match the non-resident path —
+    valid and escalated-invalid alike."""
+    from jepsen_tpu.checker import sharded
+
+    good, bad = burst_history(), burst_history(bad_tail=True)
+    base_good = bs.check_steps_bitset_segmented(
+        _steps(good), model="cas-register", S=8, interpret=True,
+        min_len=1,
+    )
+    base_bad = bs.check_steps_bitset_segmented(
+        _steps(bad), model="cas-register", S=8, interpret=True,
+        min_len=1,
+    )
+    assert base_good[0] is True and base_bad[0] is False
+    monkeypatch.setattr(sharded, "residency_supported", lambda: True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        bs.reset_launch_stats()
+        forced_good = bs.check_steps_bitset_segmented(
+            _steps(good), model="cas-register", S=8, interpret=True,
+            min_len=1,
+        )
+        assert bs.LAUNCH_STATS["donated_buffers"] >= 1
+        assert bs.LAUNCH_STATS["host_syncs"] == 1
+        forced_bad = bs.check_steps_bitset_segmented(
+            _steps(bad), model="cas-register", S=8, interpret=True,
+            min_len=1,
+        )
+    assert forced_good == base_good
+    assert forced_bad == base_bad
+
+
+@pytest.mark.mesh
+def test_forced_residency_streaming_differential_on_mesh(
+    small_w, monkeypatch
+):
+    """The streaming handle under forced donation on the 8-device
+    tier-1 mesh env: every append chains from a donated frontier and
+    the final verdict still equals the one-shot check's."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    from jepsen_tpu.checker import sharded
+
+    h = burst_history(rounds=2, bad_tail=True)
+    ref = _oneshot(h)
+    ops = list(h.ops)
+    monkeypatch.setattr(sharded, "residency_supported", lambda: True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        bs.reset_launch_stats()
+        sc = StreamingCheck(model="cas-register", interpret=True)
+        for i in range(0, len(ops), 40):
+            sc.append(ops[i:i + 40])
+        out = sc.result()
+    assert bs.LAUNCH_STATS["donated_buffers"] >= 1
+    assert _verdict_fields(out) == _verdict_fields(ref)
+
+
+# -- incremental == one-shot ------------------------------------------
+
+
+def test_append_incremental_matches_oneshot_valid(small_w):
+    h = burst_history(rounds=2)
+    ref = _oneshot(h)
+    ops = list(h.ops)
+    reset_stream_stats()
+    bs.reset_launch_stats()
+    sc = StreamingCheck(model="cas-register", interpret=True)
+    n_appends = 0
+    for i in range(0, len(ops), 24):
+        status = sc.append(ops[i:i + 24])
+        assert status["valid?"] is True  # provisional, never deferred
+        n_appends += 1
+    out = sc.result()
+    assert out["valid?"] is ref["valid?"] is True
+    st = stream_stats()
+    assert st["appends"] == n_appends
+    assert st["deferred"] == 0 and st["escalations"] == 0
+    # the residency contract, incrementally: ONE host sync per append
+    assert bs.LAUNCH_STATS["host_syncs"] == n_appends
+    assert st["tail_launches"] == n_appends
+
+
+def test_append_incremental_matches_oneshot_invalid(small_w):
+    h = burst_history(rounds=2, bad_tail=True)
+    ref = _oneshot(h)
+    assert ref["valid?"] is False
+    ops = list(h.ops)
+    sc = StreamingCheck(model="cas-register", interpret=True)
+    saw_false = False
+    for i in range(0, len(ops), 24):
+        saw_false = sc.append(ops[i:i + 24])["valid?"] is False
+    assert saw_false  # the append that delivered the bad tail caught it
+    out = sc.result()
+    assert _verdict_fields(out) == _verdict_fields(ref)
+    assert out["failure"]["failed_op"] == ref["failure"]["failed_op"]
+    # invalid is terminal: more ops cannot revive the stream
+    again = sc.append(list(burst_history(pairs=2, nburst=2).ops))
+    assert again["valid?"] is False
+    assert sc.result()["failed_op_index"] == ref["failed_op_index"]
+
+
+def test_checker_check_streaming_handle(small_w):
+    """LinearizableChecker.check_streaming binds the checker's config;
+    one append + result equals the checker's own one-shot check."""
+    h = burst_history()
+    checker = LinearizableChecker(interpret=True)
+    ref = checker.check({}, h)
+    sc = checker.check_streaming()
+    sc.append(list(h.ops))
+    assert _verdict_fields(sc.result()) == _verdict_fields(ref)
+
+
+# -- kill / resume ----------------------------------------------------
+
+
+def test_stream_resume_after_handle_drop(tmp_path, small_w):
+    """Drop a durable handle mid-stream (the in-process analog of a
+    SIGKILL: nothing but the atomically persisted stream.json
+    survives) and replay the full history through a fresh handle on
+    the same path: it resumes past the checked prefix — strictly less
+    tail work — with the identical verdict."""
+    p = str(tmp_path / "stream.json")
+    h = burst_history(rounds=2)
+    ref = _oneshot(h)
+    ops = list(h.ops)
+    # cut at the end of round 1: every prefix op is closed AND the
+    # prefix has already seen the widest window, so the resumed
+    # encoding keeps the same W bucket (a narrower prefix would
+    # re-bucket and correctly reject the frontier)
+    cut = 70
+    sc1 = StreamingCheck(model="cas-register", interpret=True, path=p)
+    sc1.append(ops[:cut])
+    assert os.path.exists(p)
+    del sc1  # no finalizer work: durability is the atomic writes only
+    reset_stream_stats()
+    sc2 = StreamingCheck(model="cas-register", interpret=True, path=p)
+    sc2.append(ops)
+    assert sc2.resumed
+    st = stream_stats()
+    assert st["resumes"] == 1 and st["invalidations"] == 0
+    out = sc2.result()
+    assert _verdict_fields(out) == _verdict_fields(ref)
+    assert out["valid?"] is True
+    assert out["streaming"]["resumed"] is True
+    # the resumed handle checked only the tail, not the whole stream
+    full_steps = len(_steps(h))
+    assert 0 < st["tail_steps"] < full_steps
+
+
+@pytest.mark.slow
+def test_sigkill_stream_resume_differential(tmp_path):
+    """A real SIGKILL mid-stream: the child process appends a prefix
+    through a durable handle and dies without cleanup; a fresh process
+    over the same stream.json resumes and reaches the verdict of an
+    uninterrupted one-shot check."""
+    ops = list(burst_history(rounds=3).ops) + _bad_read_tail()
+    h = History(ops)
+    cut = 70  # end of round 1: closed prefix, widest window seen
+    opsfile = os.path.join(str(tmp_path), "ops.jsonl")
+    with open(opsfile, "w") as f:
+        for op in ops:
+            f.write(json.dumps(op_to_json(op)) + "\n")
+    p = os.path.join(str(tmp_path), "stream.json")
+    child = (
+        "import json, os, signal\n"
+        "from jepsen_tpu.checker.streaming import StreamingCheck\n"
+        "from jepsen_tpu.store import op_from_json\n"
+        f"ops = [op_from_json(json.loads(l)) for l in open({opsfile!r})]\n"
+        f"sc = StreamingCheck(model='cas-register', interpret=True,"
+        f" path={p!r})\n"
+        f"sc.append(ops[:{cut}])\n"
+        "os.kill(os.getpid(), signal.SIGKILL)\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", child], env=env, timeout=540,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    assert proc.returncode == -signal.SIGKILL
+    assert os.path.exists(p)
+    ref = _oneshot(h)
+    reset_stream_stats()
+    sc = StreamingCheck(model="cas-register", interpret=True, path=p)
+    sc.append(ops)
+    assert sc.resumed and stream_stats()["resumes"] == 1
+    assert _verdict_fields(sc.result()) == _verdict_fields(ref)
+
+
+def test_prefix_rewrite_invalidates_to_cold(small_w):
+    """A handle whose already-checked prefix is rewritten (different
+    ops entirely — the adversarial flavor of a late completion) must
+    restart from step 0, never chain a stale frontier."""
+    a = list(burst_history().ops)
+    b = list(burst_history().ops)
+    # rewrite the certified prefix: the first pair writes a different
+    # value, which reorders value-code assignment for every later row
+    b[0] = invoke_op(0, "write", 2)
+    b[1] = ok_op(0, "write", 2)
+    sc = StreamingCheck(model="cas-register", interpret=True)
+    sc.append(a)
+    reset_stream_stats()
+    sc._ops = list(b)  # simulate the reclassified prefix
+    sc.append(_bad_read_tail())
+    assert stream_stats()["invalidations"] == 1
+    ref = _oneshot(History(b + _bad_read_tail()))
+    assert ref["valid?"] is False
+    assert _verdict_fields(sc.result()) == _verdict_fields(ref)
+
+
+# -- cli: analyze --follow --------------------------------------------
+
+
+def test_cli_analyze_follow_tails_growing_history(
+    tmp_path, monkeypatch, small_w
+):
+    """`analyze --follow` on a history.jsonl that grows underneath it:
+    the follow picks up appended ops, terminates on the invalid tail,
+    and exits with the invalid code."""
+    from jepsen_tpu import cli
+    from jepsen_tpu.store import Store
+
+    monkeypatch.setenv("JEPSEN_TPU_INTERPRET", "1")
+    h = burst_history(rounds=2, bad_tail=True)
+    ops = list(h.ops)
+    st = Store(str(tmp_path))
+    test = {
+        "name": "follow", "workload": "register",
+        "history": History(ops[:40]),
+    }
+    d = st.make_run_dir(test)
+    st.save_1(test)
+    hist = os.path.join(d, "history.jsonl")
+
+    def _writer():
+        time.sleep(0.6)
+        with open(hist, "a") as f:
+            for op in ops[40:]:
+                f.write(json.dumps(op_to_json(op)) + "\n")
+
+    t = threading.Thread(target=_writer)
+    t.start()
+    try:
+        rc = cli.main([
+            "analyze", d, "--workload", "register",
+            "--store", str(tmp_path), "--follow", "--follow-idle", "5",
+        ])
+    finally:
+        t.join()
+    assert rc == cli.EXIT_INVALID
+    assert stream_stats()["appends"] >= 2  # it really followed
+
+
+def test_cli_analyze_follow_rejects_other_workloads(tmp_path):
+    from jepsen_tpu import cli
+    from jepsen_tpu.store import Store
+
+    st = Store(str(tmp_path))
+    test = {"name": "f2", "workload": "bank", "history": burst_history()}
+    d = st.make_run_dir(test)
+    st.save_1(test)
+    rc = cli.main([
+        "analyze", d, "--workload", "bank", "--store", str(tmp_path),
+        "--follow",
+    ])
+    assert rc == cli.EXIT_USAGE
+
+
+# -- service: POST /check/stream --------------------------------------
+
+
+def _daemon(tmp_path, **kw):
+    from jepsen_tpu.service.server import CheckerDaemon
+
+    kw.setdefault("interpret", True)
+    kw.setdefault("root", str(tmp_path / "store"))
+    return CheckerDaemon(port=0, **kw)
+
+
+def _close(daemon):
+    from jepsen_tpu.checker import chaos, dispatch
+
+    daemon.close()
+    dispatch.reset_default_plane()
+    chaos.reset_resilience()
+
+
+def _chunk(stream_id, ops, final=False, **extra):
+    return json.dumps({
+        "stream_id": stream_id,
+        "ops": [op_to_json(op) for op in ops],
+        "final": final, **extra,
+    }).encode()
+
+
+@pytest.mark.service
+def test_service_stream_chunks_then_final_verdict(tmp_path, small_w):
+    h = burst_history(rounds=2, bad_tail=True)
+    ref = _oneshot(h)
+    ops = list(h.ops)
+    d = _daemon(tmp_path)
+    try:
+        code, out = d.handle_stream("alice", _chunk("s1", ops[:40]))
+        assert code == 202
+        assert out["valid?"] is True and out["stream_id"] == "s1"
+        code, out = d.handle_stream(
+            "alice", _chunk("s1", ops[40:], final=True)
+        )
+        assert code == 200
+        assert _verdict_fields(out) == _verdict_fields(ref)
+        assert out["tenant"] == "alice"
+        row = d.ledger.snapshot()["alice"]
+        assert row["stream_chunks"] == 2
+        assert row["completed"] == 1 and row["invalid"] == 1
+        # the handle is gone: a new final chunk starts a NEW stream
+        code, out = d.handle_stream("alice", _chunk("s1", [], final=True))
+        assert code == 200 and out["valid?"] is True
+        # malformed: stream_id is required
+        code, out = d.handle_stream("alice", b'{"ops": []}')
+        assert code == 400 and out["error"] == "bad-request"
+    finally:
+        _close(d)
+
+
+@pytest.mark.service
+def test_service_durable_stream_survives_daemon_restart(
+    tmp_path, small_w
+):
+    """A durable stream persists its frontier under the service
+    checkpoint root: after a daemon restart the client replays the
+    stream from the start and the new daemon resumes it instead of
+    re-checking the prefix."""
+    h = burst_history(rounds=2)
+    ops = list(h.ops)
+    d1 = _daemon(tmp_path)
+    try:
+        code, _ = d1.handle_stream(
+            "bob", _chunk("s9", ops[:70], durable=True)
+        )
+        assert code == 202
+    finally:
+        _close(d1)
+    reset_stream_stats()
+    d2 = _daemon(tmp_path)
+    try:
+        code, out = d2.handle_stream(
+            "bob", _chunk("s9", ops, final=True, durable=True)
+        )
+        assert code == 200 and out["valid?"] is True
+        assert out["streaming"]["resumed"] is True
+        assert stream_stats()["resumes"] == 1
+        assert d2.ledger.snapshot()["bob"]["durable_resumes"] == 1
+    finally:
+        _close(d2)
