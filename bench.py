@@ -935,6 +935,193 @@ def bench_config5():
     }
 
 
+def bench_config6():
+    """Adya G1c dependency-graph search, 200k list-append txns with one
+    planted wr-cycle: WCC-bucketed adjacency stacks + repeated-squaring
+    matmul census vs a reference-shaped pure-Python fold (Elle's
+    record-at-a-time shape: dict/set edge inference, iterative Tarjan
+    SCC census, per-rw-candidate BFS — no numpy). The columnar txn
+    plane is encoded, and its edge arrays derived, once outside the
+    timed region (config 4's convention: the plane is the form this
+    framework records and persists, and extraction is memoized on it);
+    the timed device path pays component decomposition, adjacency
+    packing, the launch, census reduction, and witness extraction every
+    rep. fold_txn_graph (the vectorized parity oracle) is asserted
+    untimed — it shares the fast helpers, so it is an equivalence
+    check, not the baseline."""
+    from jepsen_tpu.checker import dispatch
+    from jepsen_tpu.checker import txn_graph as tg
+    from jepsen_tpu.sim import gen_txn_graph_history
+
+    h = gen_txn_graph_history(
+        random.Random(66), n_txns=_n(200_000, 400), anomaly="g1c",
+        cycle_len=3,
+    )
+    plane = tg.encode_txn_graph(h)
+    checker = tg.TxnGraphChecker()
+    checker.check({}, plane)  # warmup/compile + edge-extraction memo
+    tg.reset_txn_graph_stats()
+    graph_req0 = dispatch.DISPATCH_STATS["graph_requests"]
+    graph_bat0 = dispatch.DISPATCH_STATS["graph_batches"]
+    tpu_wall, r = _time(lambda: checker.check({}, plane), reps=3)
+    assert r["valid?"] is False and r["census"]["G1c"] == 3, r
+
+    def fold_check():
+        # Record-level edge inference, one committed txn at a time
+        # (the history is pure list-append, so only the append rules
+        # apply — same scoping as config 5's pairwise baseline).
+        txns = [o.value for o in h.ops if o.type == "ok" and o.f == "txn"]
+        obs, appends, writer = {}, {}, {}
+        ext_reads = []
+        for t, mops in enumerate(txns):
+            touched = set()
+            for f, k, v in mops:
+                if f == "r":
+                    if k not in touched:
+                        ov = tuple(v)
+                        ext_reads.append((t, k, ov))
+                        obs.setdefault(k, []).append(ov)
+                else:
+                    appends.setdefault(k, []).append(v)
+                    writer[(k, v)] = t
+                touched.add(k)
+        chains = {}
+        for k, seen in obs.items():
+            chain = max(seen, key=len)
+            for ov in seen:  # every observation must be a prefix
+                assert ov == chain[:len(ov)], (k, ov)
+            chains[k] = chain
+        for k, vals in appends.items():
+            if not chains.get(k) and len(vals) == 1:
+                chains[k] = (vals[0],)
+        wr, ww, rw = set(), set(), set()
+        for k, chain in chains.items():
+            for a, b in zip(chain, chain[1:]):
+                u, v = writer[(k, a)], writer[(k, b)]
+                if u != v:
+                    ww.add((u, v))
+        for t, k, ov in ext_reads:
+            chain = chains.get(k, ())
+            if ov:
+                u = writer[(k, ov[-1])]
+                if u != t:
+                    wr.add((u, t))
+            if len(ov) < len(chain):
+                v = writer[(k, chain[len(ov)])]
+                if v != t:
+                    rw.add((t, v))
+
+        def adj_of(pairs):
+            a = {}
+            for u, v in pairs:
+                a.setdefault(u, []).append(v)
+            return a
+
+        def tarjan(a):
+            comp, low, num, on = {}, {}, {}, set()
+            stack, nxt = [], [0]
+            for root in a:
+                if root in num:
+                    continue
+                work = [(root, 0)]
+                while work:
+                    u, pi = work.pop()
+                    if pi == 0:
+                        num[u] = low[u] = nxt[0]
+                        nxt[0] += 1
+                        stack.append(u)
+                        on.add(u)
+                    recurse = False
+                    outs = a.get(u, ())
+                    for i in range(pi, len(outs)):
+                        w = outs[i]
+                        if w not in num:
+                            work.append((u, i + 1))
+                            work.append((w, 0))
+                            recurse = True
+                            break
+                        if w in on:
+                            low[u] = min(low[u], num[w])
+                    if recurse:
+                        continue
+                    if low[u] == num[u]:
+                        while True:
+                            w = stack.pop()
+                            on.discard(w)
+                            comp[w] = u
+                            if w == u:
+                                break
+                    if work:
+                        p = work[-1][0]
+                        low[p] = min(low[p], low[u])
+            return comp
+
+        def reaches(a, src, dst):
+            seen, frontier = {src}, [src]
+            while frontier:
+                u = frontier.pop()
+                if u == dst:
+                    return True
+                for w in a.get(u, ()):
+                    if w not in seen:
+                        seen.add(w)
+                        frontier.append(w)
+            return False
+
+        wrww_adj = adj_of(wr | ww)
+        comp1 = tarjan(wrww_adj)
+        sizes = {}
+        for c in comp1.values():
+            sizes[c] = sizes.get(c, 0) + 1
+        g1c = sum(n for n in sizes.values() if n > 1)
+        compf = tarjan(adj_of(wr | ww | rw))
+        cands = sorted(
+            (u, v) for u, v in rw
+            if compf.get(u) is not None and compf.get(u) == compf.get(v)
+        )
+        gs = sum(1 for u, v in cands if reaches(wrww_adj, v, u))
+        census = {"G1c": g1c, "G-single": gs, "G2-item": len(cands)}
+        return {"valid?": not any(census.values()), "census": census}
+
+    oracle_wall, ref = _time(fold_check)
+    want = {k: r[k] for k in ("valid?", "census")}
+    assert ref == want, (ref, want)
+
+    # Full-verdict equivalence (witnesses included) against the
+    # vectorized parity oracle, untimed.
+    full = tg.fold_txn_graph(h)
+    drop = ("method", "components", "matmul_rounds", "degraded")
+    assert {k: v for k, v in r.items() if k not in drop} == \
+        {k: v for k, v in full.items() if k not in drop}, (r, full)
+    return {
+        "name": "g1c-200k",
+        "n_ops": len(h.ops) // 2,
+        "tpu_wall": tpu_wall,
+        "oracle_wall": oracle_wall,
+        "baseline": "reference-shaped python record fold + tarjan "
+                    "census + per-candidate bfs",
+        "method": "wcc-bucketed repeated-squaring matmul",
+        # The JSON txn_graph block: inferred edge volume, squaring
+        # rounds, and graph-bucket coalescing over the timed reps.
+        "txn_graph": {
+            "n_txns": r["n_txns"],
+            "edges": r["edges"],
+            "census": r["census"],
+            "matmul_rounds": tg.TXN_GRAPH_STATS["matmul_rounds"],
+            "device_graphs": tg.TXN_GRAPH_STATS["device_graphs"],
+            "oversize_components": (
+                tg.TXN_GRAPH_STATS["oversize_components"]
+            ),
+            "graph_requests": (
+                dispatch.DISPATCH_STATS["graph_requests"] - graph_req0
+            ),
+            "graph_batches": (
+                dispatch.DISPATCH_STATS["graph_batches"] - graph_bat0
+            ),
+        },
+    }
+
+
 # -- engine statistics (VERDICT r3 #9) ---------------------------------------
 
 
@@ -1109,6 +1296,7 @@ def main() -> None:
         bench_config3(),
         bench_config4(),
         bench_config5(),
+        bench_config6(),
     ]
 
     # Bench guard (mesh execution): >1 visible device but the register
@@ -1347,6 +1535,15 @@ def main() -> None:
                     }
                     for c in configs
                 ],
+                # txn_graph: the transactional dependency-graph
+                # record for g1c-200k — edge volume per class, the
+                # repeated-squaring round count, and how many graph
+                # adjacency requests coalesced into how many launches.
+                "txn_graph": next(
+                    (c.get("txn_graph") for c in configs
+                     if c["name"] == "g1c-200k"),
+                    None,
+                ),
                 "host_prep": host_prep,
                 "engine_stats": stats,
             }

@@ -46,6 +46,9 @@ _EXPORTS = {
     "set_full": "jepsen_tpu.checker.reductions",
     "total_queue": "jepsen_tpu.checker.reductions",
     "unique_ids": "jepsen_tpu.checker.reductions",
+    "TxnGraphChecker": "jepsen_tpu.checker.txn_graph",
+    "fold_txn_graph": "jepsen_tpu.checker.txn_graph",
+    "txn_graph_checker": "jepsen_tpu.checker.txn_graph",
 }
 
 __all__ = list(_EXPORTS)

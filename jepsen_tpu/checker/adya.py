@@ -25,6 +25,8 @@ from typing import Any, Dict, List
 
 import numpy as np
 
+from jepsen_tpu.utils.util import natural_key
+
 
 @dataclass
 class G2Plane:
@@ -76,6 +78,24 @@ class G2Checker:
         )
 
     def check(self, test, history, opts=None) -> dict:
+        if not isinstance(history, G2Plane):
+            from jepsen_tpu.history.history import History
+
+            if not isinstance(history, History):
+                history = History(list(history))
+            from jepsen_tpu.checker.txn_graph import is_txn_value
+
+            if any(
+                o.type == "ok" and is_txn_value(o.value)
+                for o in history.ops
+            ):
+                # General micro-op txn history: the two-insert
+                # bincount below can't see these. Route through the
+                # dependency-graph plane restricted to G2-item (any
+                # cycle with an rw anti-dependency — the predicate
+                # write-skew census, generalized), translating its
+                # verdict into this checker's shape.
+                return self._check_txn_history(test, history, opts)
         plane = (
             history
             if isinstance(history, G2Plane)
@@ -97,10 +117,9 @@ class G2Checker:
         )
         bad = np.nonzero(ok_counts > 1)[0]
         pairs = [(plane.keys[i], int(ok_counts[i])) for i in bad]
-        try:  # natural key order (adya.clj's sorted map); repr fallback
-            pairs.sort()  # noqa: furb — mixed types raise
-        except TypeError:
-            pairs.sort(key=lambda kv: repr(kv[0]))
+        # natural key order (adya.clj's sorted map), total over mixed
+        # key types
+        pairs.sort(key=lambda kv: natural_key(kv[0]))
         illegal = dict(pairs)
         insert_count = int(np.count_nonzero(ok_counts))
         return {
@@ -109,6 +128,37 @@ class G2Checker:
             "legal_count": insert_count - len(illegal),
             "illegal_count": len(illegal),
             "illegal": illegal,
+        }
+
+    @staticmethod
+    def _check_txn_history(test, history, opts) -> dict:
+        """G2 over general txn histories via the dependency-graph
+        checker (classes=("G2-item",)): illegal maps each key carrying
+        an rw edge of the minimal witness cycle to the G2-item census
+        (distinct rw pairs closed by a cycle); the full graph verdict
+        rides along under "txn_graph"."""
+        from jepsen_tpu.checker.txn_graph import TxnGraphChecker
+
+        tg = TxnGraphChecker(classes=("G2-item",)).check(
+            test, history, opts
+        )
+        count = int(tg.get("census", {}).get("G2-item", 0))
+        wit = (tg.get("anomalies") or {}).get("G2-item") or {}
+        bad_keys = sorted(
+            {s["key"] for s in wit.get("steps", ())
+             if s["type"] == "rw"},
+            key=natural_key,
+        )
+        n_keys = tg.get("n_keys")
+        return {
+            "valid?": tg.get("valid?"),
+            "key_count": n_keys,
+            "legal_count": (
+                None if n_keys is None else n_keys - len(bad_keys)
+            ),
+            "illegal_count": count,
+            "illegal": {k: count for k in bad_keys},
+            "txn_graph": tg,
         }
 
 

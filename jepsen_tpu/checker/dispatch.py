@@ -150,6 +150,12 @@ DISPATCH_STATS = {
     "train_registers": 0,
     "train_inflight_accum": 0,
     "backpressure_collects": 0,
+    # Txn dependency-graph bucket kind (checker/txn_graph.py):
+    # adjacency-batch submissions accepted and the coalesced graph
+    # launches formed from them — graph_requests / graph_batches > 1
+    # means concurrent graph checks actually shared a launch.
+    "graph_requests": 0,
+    "graph_batches": 0,
 }
 
 _stats_lock = threading.Lock()
@@ -515,6 +521,48 @@ class DispatchPlane:
                 max_window=1 << 20,
             )
         return self.submit(events, model=name)
+
+    def submit_graph(self, wrww, allm, rw, need=(True, True)
+                     ) -> CheckFuture:
+        """Queue one txn dependency-graph adjacency batch (the "graph"
+        bucket kind, checker/txn_graph.py): wrww/allm float32 and rw
+        bool, each [B, N, N]. Batches bucket by (N, edge-class needs),
+        so concurrent graph checks with same-sized components coalesce
+        into one stacked closure launch exactly like bitset buckets.
+        The future resolves to raw per-graph int32 count arrays
+        (g1c, g_single, g2), each [B] — no verdict wrapping; the
+        TxnGraphChecker builds the verdict host-side."""
+        wrww = np.asarray(wrww, np.float32)
+        allm = np.asarray(allm, np.float32)
+        rw = np.asarray(rw, bool)
+        if wrww.ndim != 3 or wrww.shape != allm.shape or \
+                wrww.shape != rw.shape:
+            raise ValueError(
+                f"graph stacks must share one [B, N, N] shape, got "
+                f"{wrww.shape}/{allm.shape}/{rw.shape}"
+            )
+        fut = CheckFuture(self, None, "txn-graph")
+        fut.kind = "graph"
+        fut.wrap = False
+        fut.graph = (wrww, allm, rw)
+        fut.key = ("graph", int(wrww.shape[-1]), bool(need[0]),
+                   bool(need[1]))
+        _bump("requests")
+        _bump("graph_requests")
+        full = None
+        with self._lock:
+            b = self._buckets.get(fut.key)
+            if b is None:
+                b = self._buckets[fut.key] = _Bucket()
+            b.futs.append(fut)
+            fut._bucketed_at = time.perf_counter()
+            if len(b.futs) >= self.max_batch:
+                full = fut.key
+        if full is not None:
+            self._flush_bucket(full)
+        elif self._worker is not None:
+            self._wake.set()
+        return fut
 
     def flush(self) -> None:
         """Prep everything queued and dispatch every pending bucket
@@ -1007,6 +1055,8 @@ class DispatchPlane:
         try:
             if key[0] == "bitset":
                 self._dispatch_bitset_batch(b.futs, key)
+            elif key[0] == "graph":
+                self._dispatch_graph_batch(b.futs, key)
             else:
                 self._dispatch_vmap_batch(b.futs, key)
         except BaseException as e:  # noqa: BLE001
@@ -1035,6 +1085,65 @@ class DispatchPlane:
         launch.handle = handle
         self._note_launch(len(futs), mesh_used)
         self._register_launch(launch)
+
+    #: coalesced graph launch memory cap, in elements per adjacency
+    #: stack (3 stacks + 2 closures ride each launch)
+    GRAPH_LAUNCH_ELEMS = 1 << 24
+
+    def _dispatch_graph_batch(self, futs, key) -> None:
+        """Concatenate same-shaped adjacency stacks into coalesced
+        closure launches. Groups are bounded by GRAPH_LAUNCH_ELEMS so a
+        max_batch pile-up of big stacks cannot blow device memory — an
+        over-cap single future still launches (alone)."""
+        _, n, need1, need2 = key
+        per_graph = n * n
+        group: list = []
+        elems = 0
+        for f in futs:
+            b = int(f.graph[0].shape[0])
+            if group and elems + b * per_graph > self.GRAPH_LAUNCH_ELEMS:
+                self._launch_graph_group(group, need1, need2)
+                group, elems = [], 0
+            group.append(f)
+            elems += b * per_graph
+        if group:
+            self._launch_graph_group(group, need1, need2)
+
+    def _launch_graph_group(self, futs, need1: bool, need2: bool) -> None:
+        from jepsen_tpu.checker import txn_graph as tg
+
+        sizes = [int(f.graph[0].shape[0]) for f in futs]
+        if len(futs) == 1:
+            stacks = futs[0].graph
+        else:
+            stacks = tuple(
+                np.concatenate([f.graph[i] for f in futs], axis=0)
+                for i in range(3)
+            )
+
+        def launch_with(mesh):
+            return tg.launch_graph_batch(
+                *stacks, need1=need1, need2=need2, mesh=mesh,
+            )
+
+        handle, mesh_used, pf = self._dispatch_resilient(
+            launch_with, tags=_tenant_tags(futs)
+        )
+        if handle is None:
+            # no events to re-decide host-side: the checker catches the
+            # PlaneFault at result() and runs its own census fallback
+            for f in futs:
+                chaos.note_plane_fault()
+                self._observe(f, "plane_fault")
+                f._fail(pf)
+            return
+        _bump("graph_batches")
+        launch = _Launch("graph", futs, {"sizes": sizes})
+        launch.handle = handle
+        self._note_launch(len(futs), mesh_used)
+        self._register_launch(launch)
+        for f in futs:
+            f.graph = None  # host stacks are dead weight once launched
 
     def _dispatch_vmap_batch(self, futs, key) -> None:
         import jax.numpy as jnp
@@ -1267,8 +1376,21 @@ class DispatchPlane:
             self._resolve_bitset(launch, host)
         elif launch.kind == "segmented":
             self._resolve_segmented(launch, host)
+        elif launch.kind == "graph":
+            self._resolve_graph(launch, host)
         else:
             self._resolve_vmap(launch, host)
+
+    def _resolve_graph(self, launch: _Launch, host) -> None:
+        """Slice the stacked per-graph count arrays back out to each
+        rider: future i gets (g1c, g_single, g2), each [B_i]. Mesh
+        padding rows live past the riders' total and are never read."""
+        arrs = [np.asarray(a) for a in host]
+        off = 0
+        for f, b in zip(launch.futs, launch.meta["sizes"]):
+            if not f.done():
+                f._resolve(tuple(a[off:off + b] for a in arrs))
+            off += b
 
     def _finish(self, fut: CheckFuture, out: dict) -> None:
         """Deliver a device-side verdict, running the racer crosscheck

@@ -143,7 +143,16 @@ class LongForkChecker:
                 for si, (state, _) in enumerate(states):
                     live[gi, si] = True
                     V[gi, si, :] = state
-            pair = np.asarray(_fork_kernel()(V, live))
+            # One solo device launch for the whole batched group
+            # matmul — registered with the plane ledgers so bench's
+            # residency block counts it like any bitset launch.
+            from jepsen_tpu.checker import dispatch as _dispatch
+            from jepsen_tpu.checker import wgl_bitset as _bs
+
+            _dispatch._bump("requests")
+            _dispatch._bump("solo_launches")
+            _bs._bump_launch("launches")
+            pair = np.asarray(_bs._host_get(_fork_kernel()(V, live)))
             fork_list = []
             for gi, ri, si in zip(*np.nonzero(np.triu(pair, k=1))):
                 a = glist[gi][1][ri][1]

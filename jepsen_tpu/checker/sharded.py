@@ -586,3 +586,118 @@ def vmap_verdicts(
             r["escalations"] = r.get("escalations", 0) + 1
             out.append(r)
     return out
+
+
+# -- txn dependency-graph closure (checker/txn_graph.py) ---------------------
+
+
+def row_spec(mesh: Mesh) -> P:
+    """Row sharding for a single [N, N] adjacency matrix: rows split
+    across every mesh axis, columns replicated — the layout of the
+    oversize-component closure."""
+    return P(tuple(mesh.axis_names), None)
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_graph(mesh: Mesh, n_iters: int, need1: bool,
+                       need2: bool):
+    """Batch-axis sharded repeated-squaring cycle kernel: [B, N, N]
+    adjacency stacks split over the mesh on the batch axis (graphs are
+    independent components, so the per-shard closure is collective-free
+    — the same layout story as the vmap checker)."""
+    spec = key_spec(mesh)
+
+    def per_shard(wrww, allm, rw):
+        from jepsen_tpu.checker.txn_graph import _graph_counts_body
+
+        return _graph_counts_body(wrww, allm, rw, n_iters, need1, need2)
+
+    try:
+        sharded = _shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(spec,) * 3,
+            out_specs=(spec, spec, spec),
+            check_vma=False,
+        )
+    except TypeError:  # pragma: no cover - older JAX
+        sharded = _shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(spec,) * 3,
+            out_specs=(spec, spec, spec),
+            check_rep=False,
+        )
+    return jax.jit(sharded)
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_graph_rows(mesh: Mesh, n_iters: int, need1: bool,
+                            need2: bool):
+    """Row-sharded closure for one oversize component: each device owns
+    a block of rows of the [N, N] reachability matrix and squares it
+    against the all_gather'd full matrix (Rblk = min(Rblk + Rblk @ R,
+    1)) — log2(N) rounds of block matmul + gather, then psum'd scalar
+    anomaly counts."""
+    axes = tuple(mesh.axis_names)
+    axis_sizes = tuple(mesh.shape[a] for a in axes)
+
+    def per_shard(wrww, allm, rw):
+        rows = wrww.shape[0]
+        n = rows * int(np.prod(axis_sizes))
+
+        def closure(blk):
+            def body(_, r):
+                full = jax.lax.all_gather(r, axes, axis=0, tiled=True)
+                sq = jnp.dot(r, full, preferred_element_type=jnp.float32)
+                return jnp.minimum(r + sq, 1.0)
+
+            return jax.lax.fori_loop(0, n_iters, body, blk)
+
+        idx = jnp.int32(0)
+        for ax, sz in zip(axes, axis_sizes):
+            idx = idx * sz + jax.lax.axis_index(ax)
+        row0 = idx * rows
+        z = jnp.zeros((), jnp.int32)
+        rwb = rw > 0
+        g1c = gs = g2 = z
+
+        def rw_hits(c):
+            cf = jax.lax.all_gather(c, axes, axis=0, tiled=True)  # [N, N]
+            # this block's rows of closure.T: cf[:, row0:row0+rows].T
+            ct = jax.lax.dynamic_slice(
+                cf, (jnp.int32(0), row0), (n, rows)).T
+            return (rwb & (ct > 0)).sum().astype(jnp.int32), cf
+
+        if need1:
+            c1 = closure(wrww)
+            hits, c1f = rw_hits(c1)
+            gs = hits
+            diag = c1f[row0 + jnp.arange(rows), row0 + jnp.arange(rows)]
+            g1c = (diag > 0).sum().astype(jnp.int32)
+        if need2:
+            c2 = closure(allm)
+            g2, _ = rw_hits(c2)
+        g1c = jax.lax.psum(g1c, axes)
+        gs = jax.lax.psum(gs, axes)
+        g2 = jax.lax.psum(g2, axes)
+        return g1c, gs, g2
+
+    spec = row_spec(mesh)
+    try:
+        sharded = _shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(spec,) * 3,
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+    except TypeError:  # pragma: no cover - older JAX
+        sharded = _shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(spec,) * 3,
+            out_specs=(P(), P(), P()),
+            check_rep=False,
+        )
+    return jax.jit(sharded)

@@ -32,8 +32,8 @@ EXIT_CRASH = 254
 EXIT_USAGE = 255
 
 WORKLOADS = (
-    "register", "register-keyed", "bank", "long-fork", "g2", "set",
-    "counter", "monotonic", "dirty-reads",
+    "register", "register-keyed", "bank", "long-fork", "g2",
+    "txn-graph", "set", "counter", "monotonic", "dirty-reads",
 )
 
 
@@ -69,6 +69,10 @@ def _workload_spec(args, rng: random.Random) -> Dict[str, Any]:
         return long_fork.workload(n_ops=args.ops, rng=rng)
     if name == "g2":
         return adya.workload(n_keys=max(args.ops // 2, 1))
+    if name == "txn-graph":
+        from jepsen_tpu.workloads import txn_graph as txn_graph_wl
+
+        return txn_graph_wl.workload(n_ops=args.ops, rng=rng)
     if name == "set":
         from jepsen_tpu.workloads import set as set_wl
 
@@ -99,6 +103,7 @@ def _checker_for(workload: str):
     from jepsen_tpu.checker.longfork import LongForkChecker
     from jepsen_tpu.checker.monotonic import MonotonicChecker
     from jepsen_tpu.checker.reductions import CounterChecker, SetFullChecker
+    from jepsen_tpu.checker.txn_graph import TxnGraphChecker
     from jepsen_tpu.workloads.adya import _KVG2Checker
 
     # Pallas interpret mode for the linearizable tiers: the seam that
@@ -115,6 +120,7 @@ def _checker_for(workload: str):
         "bank": BankChecker(),
         "long-fork": LongForkChecker(2),
         "g2": _KVG2Checker(),
+        "txn-graph": TxnGraphChecker(),
         "counter": CounterChecker(),
         "monotonic": MonotonicChecker(),
         "dirty-reads": DirtyReadsChecker(),

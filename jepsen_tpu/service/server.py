@@ -301,6 +301,20 @@ class CheckerDaemon:
                 LinearizableChecker,
             )
 
+            if model == "txn-graph":
+                # Transactional dependency-graph path: no durable
+                # checkpoint seam (graph checks are single-launch),
+                # but the submit/hold/resolve window still coalesces
+                # concurrent tenants' adjacency batches.
+                from jepsen_tpu.checker.txn_graph import TxnGraphChecker
+
+                tg = TxnGraphChecker(plane=self.plane)
+                with dispatch.tenant_context(tenant):
+                    resolver = tg.check_async({}, history)
+                    if self.coalesce_hold_s:
+                        time.sleep(self.coalesce_hold_s)
+                    return resolver()
+
             checker = LinearizableChecker(
                 model=model,
                 init_value=req.get("init_value"),

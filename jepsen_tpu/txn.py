@@ -7,8 +7,8 @@ Ref: /root/reference/txn/src/jepsen/txn/micro_op.clj:1-33 and
 
 This representation maps directly onto dense tensors: a transaction of m
 micro-ops over a history of n txns is an int32 [n, m, 3] block of
-(op_code, key, value) rows (op codes: r=0, w=1; value NIL=-1 for
-unconstrained reads).
+(op_code, key, value) rows (op codes: r=0, w=1, append=2; value NIL=-1
+for unconstrained reads).
 """
 
 from __future__ import annotations
@@ -20,8 +20,9 @@ import numpy as np
 
 R = "r"
 W = "w"
+APPEND = "append"
 
-OP_CODES = {R: 0, W: 1}
+OP_CODES = {R: 0, W: 1, APPEND: 2}
 NIL = -1
 
 MicroOp = Tuple[str, Any, Any]
@@ -33,6 +34,11 @@ def r(k, v=None) -> MicroOp:
 
 def w(k, v) -> MicroOp:
     return (W, k, v)
+
+
+def append(k, v) -> MicroOp:
+    """List-append micro-op: push v onto the list at k (Elle's :append)."""
+    return (APPEND, k, v)
 
 
 def op_type(mop: MicroOp) -> str:
@@ -69,7 +75,7 @@ def ext_reads(txn: Sequence[MicroOp]) -> dict:
     written = set()
     out = {}
     for f, k, v in txn:
-        if f == W:
+        if f == W or f == APPEND:
             written.add(k)
         elif f == R and k not in written and k not in out:
             out[k] = v
@@ -98,6 +104,10 @@ def apply_mop(state: dict, mop: MicroOp) -> Tuple[dict, MicroOp]:
         s = dict(state)
         s[k] = v
         return s, mop
+    if f == APPEND:
+        s = dict(state)
+        s[k] = tuple(s.get(k) or ()) + (v,)
+        return s, mop
     raise ValueError(f"unknown micro-op type {f!r}")
 
 
@@ -114,16 +124,29 @@ def gen_txn(
     max_len: int = 4,
     max_value: int = 16,
     rng: Optional[random.Random] = None,
+    mode: str = "register",
+    counter: Optional[List[int]] = None,
 ) -> List[MicroOp]:
     """Random transaction generator (simulation aid; ref txn/README.md
-    simulators for producing histories at a known isolation level)."""
+    simulators for producing histories at a known isolation level).
+
+    mode="register" emits r/w mops with small random values; mode="append"
+    emits r/append mops whose appended values are globally unique (drawn
+    from the shared mutable `counter` cell), so every version has exactly
+    one writer and wr edges are recoverable (Elle's list-append trick)."""
     rng = rng or random
     n = rng.randint(1, max_len)
     txn = []
+    keys = list(keys)
     for _ in range(n):
-        k = rng.choice(list(keys))
+        k = rng.choice(keys)
         if rng.random() < 0.5:
             txn.append(r(k))
+        elif mode == "append":
+            if counter is None:
+                counter = [0]
+            counter[0] += 1
+            txn.append(append(k, counter[0]))
         else:
             txn.append(w(k, rng.randint(0, max_value)))
     return txn

@@ -261,3 +261,21 @@ def integer_interval_set_str(xs) -> str:
         str(a) if a == b else f"{a}..{b}" for a, b in runs
     )
     return "#{" + body + "}"
+
+
+def natural_key(v) -> tuple:
+    """Deterministic total-order sort key for mixed-type values.
+
+    Numbers sort among themselves by value (bools as 0/1), strings after
+    numbers, everything else last by repr. For homogeneous int inputs the
+    order matches a plain sort, so hot paths that sort int keys keep their
+    results byte-identical. Replaces the ad-hoc try/except sorts that threw
+    on e.g. [3, "a"] key mixes.
+    """
+    if isinstance(v, bool):
+        return (0, float(v), 1, "", "")
+    if isinstance(v, (int, float)):
+        return (0, float(v), 0, "", "")
+    if isinstance(v, str):
+        return (1, 0.0, 0, v, "")
+    return (2, 0.0, 0, type(v).__name__, repr(v))
