@@ -1245,6 +1245,27 @@ def main() -> None:
             "measurement",
             file=sys.stderr,
         )
+    # Lint preflight BEFORE any device work: BENCH numbers from a
+    # tree violating the residency/locking invariants (a stray host
+    # sync, an unaccounted launch) are not publishable. planelint is
+    # stdlib-ast only, so this costs milliseconds and touches no
+    # accelerator state.
+    if "--allow-dirty-lint" not in sys.argv:
+        from jepsen_tpu import analysis
+
+        _lint_new, _ = analysis.apply_baseline(
+            analysis.run_lint(),
+            analysis.load_baseline(analysis.default_baseline_path()),
+        )
+        if _lint_new:
+            for _f in _lint_new:
+                print(_f.render(), file=sys.stderr)
+            raise SystemExit(
+                f"bench: refusing to publish from a lint-dirty tree "
+                f"({len(_lint_new)} planelint finding(s) above); fix "
+                "them or rerun with --allow-dirty-lint"
+            )
+
     # Gate BEFORE importing jax: plugin registration itself can touch
     # the wedged tunnel and hang the parent uninterruptibly — smoke
     # runs included (the probe is seconds on a healthy host).

@@ -1,0 +1,30 @@
+"""planelint: static analysis for the analysis plane's own invariants.
+
+Stdlib-ast only (no new dependencies, importable without jax): the
+rules encode at review time what PRs 2-8 enforce at runtime — the
+_host_get sync funnel, launch accounting, chaos guards, buffer
+donation discipline (Family A, JT1xx) and stats-lock / blocking-call
+/ hook discipline (Family B, JT2xx).
+
+Entry points: ``python -m jepsen_tpu.cli lint`` and
+``jepsen_tpu.analysis.run_lint()``; see README "Static analysis".
+"""
+
+from jepsen_tpu.analysis.engine import (  # noqa: F401
+    FAMILY_A_FILES,
+    FAMILY_B_FILES,
+    RULES,
+    default_baseline_path,
+    families_for,
+    lint_file,
+    lint_source,
+    package_root,
+    repo_root,
+    run_lint,
+)
+from jepsen_tpu.analysis.findings import (  # noqa: F401
+    Finding,
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+)

@@ -1,0 +1,314 @@
+"""planelint Family B: plane lock discipline.
+
+JT2xx rules over the threaded layers (dispatch plane, runtime, the
+service daemon, chaos). Lock-guard scopes are inferred syntactically
+from ``with <LOCK>:`` blocks — any context-manager expression whose
+final name segment contains "lock" counts as a plane lock.
+
+Rules:
+
+- JT201 mutation of a module-level ``*_STATS`` structure (or the
+  chaos quarantine ledger) outside a lock scope.
+- JT202 blocking call (``.join()``, ``.result()``, socket ops,
+  ``time.sleep``) while holding a plane lock. ``Condition.wait`` is
+  deliberately NOT in the set: it releases the lock it rides.
+- JT203 ``Thread(...)`` creation in a module with no bounded-join
+  seam (no ``join(timeout=...)`` anywhere) — an unjoinable thread.
+- JT204 user-hook invocation (observer/callback/on_fault/after_save
+  spellings) while holding a lock: a hook that re-enters the stats
+  API deadlocks on the non-reentrant lock, and a slow hook stalls
+  every thread contending for it.
+- JT205 aggregate read (``dict(X_STATS)``, ``.items()``, iteration)
+  of a stats structure outside a lock — a torn snapshot. Single
+  scalar subscript reads stay allowed (atomic under the GIL); the
+  sanctioned path is a locked ``snapshot()`` helper.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from jepsen_tpu.analysis.findings import Finding
+from jepsen_tpu.analysis.hotpath import _dotted, _last_seg
+
+#: guarded shared structures: module-level stats dicts + the chaos
+#: quarantine ledger
+_STATS_RE = re.compile(
+    r"(^|_)([A-Z][A-Z0-9]*_)*(STATS|FAILURES|QUARANTINED)$"
+)
+
+#: attribute calls that mutate a dict/list in place
+_MUTATORS = {
+    "update", "clear", "setdefault", "pop", "popitem", "append",
+    "extend", "insert", "remove", "__setitem__",
+}
+
+#: attribute calls that block (or can block) the calling thread.
+#: ``wait`` is excluded on purpose: Condition.wait RELEASES the lock.
+_BLOCKING_ATTRS = {
+    "join", "result", "recv", "recv_into", "send", "sendall",
+    "accept", "connect",
+}
+#: dotted calls that block
+_BLOCKING_DOTTED_TAILS = {"sleep"}  # time.sleep / _time.sleep
+
+#: hook-shaped callee names (JT204)
+_HOOK_RE = re.compile(
+    r"(observer|hook|callback|on_fault|on_drain|after_save)",
+    re.IGNORECASE,
+)
+
+#: aggregate readers (JT205)
+_AGG_READERS = {"dict", "list", "tuple", "sorted"}
+_AGG_METHODS = {"items", "values", "keys", "copy"}
+
+
+def _is_stats_expr(node: ast.expr) -> bool:
+    """Name/Attribute whose final segment matches the stats pattern
+    (``LAUNCH_STATS``, ``bs.LAUNCH_STATS``, ``_QUARANTINED``...)."""
+    seg = _last_seg(node)
+    return bool(seg) and bool(_STATS_RE.search(seg))
+
+
+def _stats_base(node: ast.expr) -> Optional[str]:
+    """The stats structure a subscript/attribute chain bottoms out in:
+    ``X_STATS[...]["..."]`` -> 'X_STATS'."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, (ast.Name, ast.Attribute)) and _is_stats_expr(
+        node
+    ):
+        return _last_seg(node)
+    return None
+
+
+def _is_lock_expr(node: ast.expr) -> bool:
+    seg = _last_seg(node)
+    return bool(seg) and "lock" in seg.lower()
+
+
+class ConcurrencyChecker(ast.NodeVisitor):
+    def __init__(self, tree: ast.Module, rel: str):
+        self.tree = tree
+        self.rel = rel
+        self.findings: List[Finding] = []
+        self.locks: List[str] = []  # currently-held lock names
+        self.symbols: List[str] = []
+        #: does this module have a bounded-join seam at all?
+        self.has_bounded_join = any(
+            isinstance(n, ast.Call)
+            and _last_seg(n.func) == "join"
+            and (
+                n.args
+                or any(kw.arg == "timeout" for kw in n.keywords)
+            )
+            for n in ast.walk(tree)
+        )
+
+    # -- plumbing ------------------------------------------------------
+
+    @property
+    def symbol(self) -> str:
+        return ".".join(self.symbols) if self.symbols else "<module>"
+
+    def add(self, rule: str, node: ast.AST, message: str,
+            severity: str = "error") -> None:
+        self.findings.append(
+            Finding(
+                rule=rule,
+                file=self.rel,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                severity=severity,
+                message=message,
+                symbol=self.symbol,
+            )
+        )
+
+    def run(self) -> List[Finding]:
+        self.visit(self.tree)
+        return self.findings
+
+    # -- scope tracking ------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.symbols.append(node.name)
+        # lock state does not cross a def boundary: the nested def
+        # runs later, on some other thread's schedule
+        held, self.locks = self.locks, []
+        self.generic_visit(node)
+        self.locks = held
+        self.symbols.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.symbols.append(node.name)
+        self.generic_visit(node)
+        self.symbols.pop()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        held, self.locks = self.locks, []
+        self.generic_visit(node)
+        self.locks = held
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            if _is_lock_expr(item.context_expr):
+                acquired.append(
+                    _last_seg(item.context_expr) or "<lock>"
+                )
+            else:
+                self.visit(item.context_expr)
+        self.locks.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.locks.pop()
+
+    # -- JT201: stats mutation outside the lock ------------------------
+
+    def _flag_mutation(self, node: ast.AST, base: str) -> None:
+        if self.locks:
+            return
+        self.add(
+            "JT201", node,
+            f"mutation of shared stats structure '{base}' outside "
+            "its lock — concurrent bumps interleave and drop counts",
+        )
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            base = (
+                _stats_base(tgt)
+                if isinstance(tgt, ast.Subscript)
+                else None
+            )
+            if base:
+                self._flag_mutation(node, base)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        base = (
+            _stats_base(node.target)
+            if isinstance(node.target, ast.Subscript)
+            else None
+        )
+        if base:
+            self._flag_mutation(node, base)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript):
+                base = _stats_base(tgt)
+                if base:
+                    self._flag_mutation(node, base)
+        self.generic_visit(node)
+
+    # -- calls: JT201 mutators, JT202/204 under-lock, JT203, JT205 -----
+
+    def visit_For(self, node: ast.For) -> None:
+        base = _stats_base(node.iter)
+        if base is None and isinstance(node.iter, ast.Call):
+            # for k in X_STATS.items()/keys()/values()
+            f = node.iter.func
+            if isinstance(f, ast.Attribute) and f.attr in _AGG_METHODS:
+                base = _stats_base(f.value)
+        if base and not self.locks:
+            self.add(
+                "JT205", node.iter,
+                f"unlocked iteration over '{base}' — a concurrent "
+                "bump tears the snapshot; read through the locked "
+                "snapshot() helper",
+            )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fd = _dotted(node.func)
+        seg = _last_seg(node.func)
+
+        # JT201: in-place mutator methods on a stats structure
+        if isinstance(node.func, ast.Attribute) and (
+            node.func.attr in _MUTATORS
+        ):
+            base = _stats_base(node.func.value)
+            if base:
+                self._flag_mutation(node, base)
+
+        # JT205: aggregate reads outside the lock
+        if not self.locks:
+            if fd in _AGG_READERS and node.args:
+                base = _stats_base(node.args[0])
+                if base:
+                    self.add(
+                        "JT205", node,
+                        f"unlocked aggregate read {fd}({base}) — a "
+                        "concurrent bump tears the snapshot; read "
+                        "through the locked snapshot() helper",
+                    )
+            if isinstance(node.func, ast.Attribute) and (
+                node.func.attr in _AGG_METHODS
+            ):
+                base = _stats_base(node.func.value)
+                if base:
+                    self.add(
+                        "JT205", node,
+                        f"unlocked aggregate read {base}."
+                        f"{node.func.attr}() — a concurrent bump "
+                        "tears the snapshot; read through the locked "
+                        "snapshot() helper",
+                    )
+
+        if self.locks:
+            held = ", ".join(self.locks)
+            # JT202: blocking calls under a plane lock
+            blocking = None
+            if isinstance(node.func, ast.Attribute) and (
+                node.func.attr in _BLOCKING_ATTRS
+            ):
+                blocking = f".{node.func.attr}()"
+            elif fd is not None and "." in fd and (
+                fd.rsplit(".", 1)[-1] in _BLOCKING_DOTTED_TAILS
+            ):
+                blocking = f"{fd}()"
+            if blocking:
+                self.add(
+                    "JT202", node,
+                    f"blocking call {blocking} while holding "
+                    f"{held} — every thread contending for the lock "
+                    "stalls behind this wait",
+                )
+            # JT204: user hooks invoked under a lock
+            if seg and _HOOK_RE.search(seg) and not (
+                seg.startswith(("add_", "remove_", "clear_", "set_",
+                                "install_"))
+            ):
+                self.add(
+                    "JT204", node,
+                    f"user hook '{seg}' invoked while holding "
+                    f"{held} — a hook that re-enters the stats API "
+                    "deadlocks; snapshot under the lock, call hooks "
+                    "after release",
+                )
+
+        # JT203: thread creation without a bounded-join seam
+        if fd in ("threading.Thread", "Thread") and (
+            not self.has_bounded_join
+        ):
+            self.add(
+                "JT203", node,
+                "Thread(...) created in a module with no bounded "
+                "join (join(timeout=...)) anywhere — an unjoinable "
+                "thread outlives every drain path",
+                severity="warning",
+            )
+
+        self.generic_visit(node)
+
+
+def check_concurrency(tree: ast.Module, rel: str) -> List[Finding]:
+    return ConcurrencyChecker(tree, rel).run()

@@ -1,0 +1,198 @@
+"""planelint engine: rule catalog, file-set configuration, runner.
+
+Two rule families over two file sets:
+
+- Family A (JT1xx, ``hotpath``) runs over the device hot-path
+  modules — the files where an implicit host sync or an unaccounted
+  launch silently reintroduces the ~94 ms tunnel floor.
+- Family B (JT2xx, ``concurrency``) runs over every threaded layer —
+  dispatch plane, runtime, service daemon, chaos — where a stats
+  write outside its lock or a blocking call under one breaks the
+  accounting/fairness contracts the tier-1 suite pins.
+
+``run_lint`` walks the package, applies inline suppressions, and
+returns findings; the CLI layers the baseline on top.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from jepsen_tpu.analysis.concurrency import check_concurrency
+from jepsen_tpu.analysis.findings import (
+    Finding,
+    apply_suppressions,
+    bare_suppression_findings,
+    parse_suppressions,
+)
+from jepsen_tpu.analysis.hotpath import check_hotpath
+
+#: Family A: the hot-path residency set (paths relative to the
+#: jepsen_tpu package root, forward slashes)
+FAMILY_A_FILES = (
+    "checker/wgl_bitset.py",
+    "checker/sharded.py",
+    "checker/dispatch.py",
+    "checker/streaming.py",
+    "checker/txn_graph.py",
+)
+
+#: Family B: the lock-discipline set
+FAMILY_B_FILES = (
+    "checker/dispatch.py",
+    "checker/chaos.py",
+    "checker/wgl_bitset.py",
+    "checker/sharded.py",
+    "checker/streaming.py",
+    "checker/txn_graph.py",
+    "checker/checkpoint.py",
+    "runtime/core.py",
+    "service/*.py",
+    "cli.py",
+)
+
+#: rule catalog: id -> (title, guarded invariant)
+RULES: Dict[str, Tuple[str, str]] = {
+    "JT001": (
+        "bare suppression",
+        "suppressions must record WHY an invariant is waived",
+    ),
+    "JT101": (
+        "implicit host sync",
+        "every device->host fetch funnels through _host_get "
+        "(one counted sync per check)",
+    ),
+    "JT102": (
+        "bare block_until_ready",
+        "sync barriers must be counted fetches, not silent waits",
+    ),
+    "JT103": (
+        "unaccounted launch",
+        "every device dispatch registers in LAUNCH_STATS",
+    ),
+    "JT104": (
+        "unguarded crossing",
+        "device crossings ride the chaos resilient_call/deadline "
+        "ladder",
+    ),
+    "JT105": (
+        "donation misuse",
+        "a buffer passed at a donate_argnums position is dead after "
+        "the call",
+    ),
+    "JT106": (
+        "jit cache-key hazard",
+        "jitted functions must not key their cache on mutable state",
+    ),
+    "JT201": (
+        "stats mutation outside lock",
+        "every *_STATS mutation happens under its declared lock",
+    ),
+    "JT202": (
+        "blocking call under lock",
+        "plane locks are held for bookkeeping only, never across "
+        "waits",
+    ),
+    "JT203": (
+        "unjoinable thread",
+        "thread creation comes with a bounded-join drain seam",
+    ),
+    "JT204": (
+        "hook invoked under lock",
+        "user hooks run outside the ledger lock (re-entrancy safe)",
+    ),
+    "JT205": (
+        "unlocked aggregate stats read",
+        "aggregate stats reads go through a locked snapshot() helper",
+    ),
+}
+
+
+def _match(rel: str, patterns: Sequence[str]) -> bool:
+    return any(fnmatch.fnmatch(rel, p) for p in patterns)
+
+
+def package_root() -> str:
+    """Absolute path of the jepsen_tpu package directory."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def repo_root() -> str:
+    return os.path.dirname(package_root())
+
+
+def default_baseline_path() -> str:
+    return os.path.join(repo_root(), "planelint_baseline.json")
+
+
+def families_for(rel: str) -> Tuple[str, ...]:
+    fams = []
+    if _match(rel, FAMILY_A_FILES):
+        fams.append("A")
+    if _match(rel, FAMILY_B_FILES):
+        fams.append("B")
+    return tuple(fams)
+
+
+def lint_source(
+    source: str,
+    rel: str = "<corpus>",
+    families: Sequence[str] = ("A", "B"),
+) -> List[Finding]:
+    """Lint one source string (the tests' corpus entry and the
+    per-file worker behind run_lint)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="JT000",
+                file=rel,
+                line=e.lineno or 0,
+                col=e.offset or 0,
+                severity="error",
+                message=f"syntax error: {e.msg}",
+            )
+        ]
+    findings: List[Finding] = []
+    if "A" in families:
+        findings.extend(check_hotpath(tree, rel))
+    if "B" in families:
+        findings.extend(check_concurrency(tree, rel))
+    suppressed, bare = parse_suppressions(source)
+    findings = apply_suppressions(findings, suppressed)
+    findings.extend(bare_suppression_findings(rel, bare))
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path: str, rel: str) -> List[Finding]:
+    fams = families_for(rel)
+    if not fams:
+        return []
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(source, rel=rel, families=fams)
+
+
+def run_lint(root: Optional[str] = None) -> List[Finding]:
+    """Lint the package tree under ``root`` (default: the installed
+    jepsen_tpu package). Findings carry package-relative paths."""
+    root = root or package_root()
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if d not in ("__pycache__", ".git")
+        )
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            findings.extend(lint_file(path, rel))
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return findings

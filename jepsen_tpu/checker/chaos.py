@@ -349,6 +349,7 @@ def run_with_deadline(fn: Callable, deadline_s: float):
         finally:
             done.set()
 
+    # planelint: disable=JT203 reason=a wedged device sync cannot be interrupted; the deadline thread is ABANDONED by design (daemon, never joined) and the caller raises PlaneFault past it
     t = threading.Thread(target=_run, daemon=True, name="plane-deadline")
     t.start()
     if not done.wait(deadline_s):
@@ -468,17 +469,58 @@ def note_plane_fault(n: int = 1) -> None:
         RESILIENCE_STATS["plane_faults"] += n
 
 
+#: quarantine observers: fn(label) runs the moment a label trips the
+#: quarantine threshold. The list has its OWN lock so registration
+#: never contends with failure accounting.
+_QUARANTINE_HOOKS: "list" = []
+_hooks_lock = threading.Lock()
+
+
+def add_quarantine_hook(fn) -> None:
+    """Register ``fn(label)`` to run when a label is quarantined.
+    Hooks are invoked OUTSIDE the stats lock (planelint JT204): a
+    hook may safely re-enter the stats API (resilience_snapshot,
+    is_quarantined, ...) without deadlocking, and a slow hook never
+    stalls other threads' failure accounting."""
+    with _hooks_lock:
+        _QUARANTINE_HOOKS.append(fn)
+
+
+def remove_quarantine_hook(fn) -> None:
+    with _hooks_lock:
+        try:
+            _QUARANTINE_HOOKS.remove(fn)
+        except ValueError:
+            pass
+
+
+def clear_quarantine_hooks() -> None:
+    with _hooks_lock:
+        _QUARANTINE_HOOKS.clear()
+
+
 def note_device_failure(label: str, quarantine_after: int = 3) -> bool:
     """Count one attributed failure against a device; returns True the
     moment the count crosses ``quarantine_after`` and the device is
-    ejected (exactly once)."""
+    ejected (exactly once). Quarantine hooks fire on that trip."""
     with _stats_lock:
         n = _DEVICE_FAILURES.get(label, 0) + 1
         _DEVICE_FAILURES[label] = n
-        if n >= quarantine_after and label not in _QUARANTINED:
+        tripped = n >= quarantine_after and label not in _QUARANTINED
+        if tripped:
             _QUARANTINED.append(label)
-            return True
-    return False
+    if tripped:
+        # snapshot the hook list under its lock, then invoke AFTER
+        # every lock is released (planelint JT204) — a hook that
+        # re-enters the stats API must not find _stats_lock held
+        with _hooks_lock:
+            hooks = tuple(_QUARANTINE_HOOKS)
+        for fn in hooks:
+            try:
+                fn(label)
+            except Exception:  # noqa: BLE001 - observer must not
+                pass  # break the accounting path it observes
+    return tripped
 
 
 def quarantined_devices() -> tuple:

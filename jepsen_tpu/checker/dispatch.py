@@ -195,6 +195,23 @@ def reset_dispatch_stats() -> None:
         DEVICE_STATS.clear()
 
 
+def snapshot() -> dict:
+    """The ONE sanctioned aggregate read of the dispatch plane's
+    stats surfaces (planelint JT205): DISPATCH_STATS + DEVICE_STATS
+    copied under _stats_lock, launch counters copied under their own
+    lock (sequentially — the two locks never nest, so no ordering
+    hazard). Everything derived (ratios, occupancies) is computed by
+    dispatch_stats() on top of this raw copy."""
+    with _stats_lock:
+        dispatch = dict(DISPATCH_STATS)
+        per_device = {k: dict(v) for k, v in DEVICE_STATS.items()}
+    return {
+        "dispatch": dispatch,
+        "per_device": per_device,
+        "launch": bs.launch_stats_snapshot(),
+    }
+
+
 def dispatch_stats() -> dict:
     """Snapshot + derived ratios for the bench JSON / run epitaphs.
 
@@ -209,9 +226,9 @@ def dispatch_stats() -> dict:
     the number of devices that actually received work — the bench's
     one-device guard trips when this reads 1 on a multi-chip host.
     """
-    with _stats_lock:
-        out = dict(DISPATCH_STATS)
-        per_dev = {k: dict(v) for k, v in DEVICE_STATS.items()}
+    snap = snapshot()
+    out = snap["dispatch"]
+    per_dev = snap["per_device"]
     launches = out["batches"] + out["solo_launches"]
     carried = out["batched_requests"] + out["solo_launches"]
     out["mean_batch_occupancy"] = (
@@ -240,7 +257,7 @@ def dispatch_stats() -> dict:
         if out["train_registers"]
         else 0.0
     )
-    out["launch"] = dict(bs.LAUNCH_STATS)
+    out["launch"] = snap["launch"]
     res = chaos.resilience_snapshot()
     res["worker_errors"] = out["worker_errors"]
     out["resilience"] = res

@@ -335,6 +335,7 @@ class StreamingCheck:
             )
             # ONE host sync per append: every tail segment's verdict
             # row plus the boundary frontier in a single fetch.
+            # planelint: disable=JT101 reason=ONE sync per append by design; the enclosing while only repeats on sticky-exact escalation (at most once per stream lifetime)
             o_host, fr_last = bs._host_get((tuple(outs), frs[-1]))
             died_seg, died = -1, -1
             taint = False
@@ -378,6 +379,7 @@ class StreamingCheck:
 
         from jepsen_tpu.checker.linearizable import _decode_value
 
+        # planelint: disable=JT104 reason=post-death artifact fetch; the counted _host_get above already paid and guarded the crossing, this pulls an array that computation materialized
         fr = np.asarray(jax.device_get(frs[died_seg]))[0]
         steps._death_frontier = fr
         out = {

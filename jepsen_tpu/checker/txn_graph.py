@@ -1285,14 +1285,18 @@ def _oversize_counts(es: EdgeSet, nodes: np.ndarray, labels: np.ndarray,
             g1c, gs, g2 = fn(*args)
             sh.note_sharded_launch(nd)
             bs._bump_launch("launches")
-            g1c, gs, g2 = (int(bs._host_get(x)) for x in (g1c, gs, g2))
+            # ONE batched tuple fetch (planelint JT101): per-element
+            # _host_get would pay the sync floor three times
+            g1c, gs, g2 = (int(v) for v in bs._host_get((g1c, gs, g2)))
             return {"G1c": g1c, "G-single": gs, "G2-item": g2}
     if size <= _SOLO_MAX_N:
         wrww, allm, rwm = _sub_edge_matrices(es, nodes, labels, comp,
                                              size)
         out = launch_graph_batch(wrww[None], allm[None], rwm[None],
                                  need1, need2, mesh=None)
-        g1c, gs, g2 = (int(np.asarray(bs._host_get(x))[0]) for x in out)
+        # ONE batched tuple fetch (planelint JT101), then host-side
+        # scalar extraction on the materialized rows
+        g1c, gs, g2 = (int(np.asarray(v)[0]) for v in bs._host_get(out))
         return {"G1c": g1c, "G-single": gs, "G2-item": g2}
     # beyond any single-device placement: host census on the component
     _note("host_fallback_components")

@@ -419,6 +419,14 @@ def reset_launch_stats() -> None:
         LAUNCH_STATS["donated_buffers"] = 0
 
 
+def launch_stats_snapshot() -> dict:
+    """Point-in-time copy of LAUNCH_STATS under its lock — the
+    sanctioned aggregate read (planelint JT205): a bare
+    dict(LAUNCH_STATS) can tear against a concurrent _bump_launch."""
+    with _launch_stats_lock:
+        return dict(LAUNCH_STATS)
+
+
 def _host_get(x):
     """THE device->host fetch. Every sync that pays the tunnel round
     trip funnels through here so LAUNCH_STATS["host_syncs"] counts
@@ -863,6 +871,7 @@ def launch_steps_bitset_segmented(
         init_frontier(steps.init_state, S, segs[0][2])[None]
     )
     if device is not None:
+        # planelint: disable=JT101 reason=args is a HOST tuple of device arrays; device_put re-commits each element without any device->host fetch
         args = tuple(jax.device_put(a, device) for a in args)
         fr0 = jax.device_put(fr0, device)
     seg_ws = tuple(W for _, _, W in segs)
@@ -923,6 +932,7 @@ def collect_steps_bitset_segmented(
                 ),
                 site="launch",
             )
+            # planelint: disable=JT101 reason=the exact escalation re-run syncs ONCE (batched tuple fetch); the enclosing loop always exits via return after it
             for o2, f2 in zip(_host_get(tuple(outs2)), frs2):
                 alive2, t2, died2 = _out_to_verdicts(np.asarray(o2))[0]
                 taint = taint or t2
@@ -1029,6 +1039,7 @@ def check_steps_bitset_segmented_checkpointed(
                     fr_host = None
                     escalated = True
                     break
+                # planelint: disable=JT104 reason=post-death artifact fetch; the group's counted _host_get already paid and guarded the crossing
                 death_fr = np.asarray(jax.device_get(frs[died_seg]))[0]
                 steps._death_frontier = death_fr
                 sink.finish(

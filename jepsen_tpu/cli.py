@@ -28,6 +28,9 @@ EXIT_UNKNOWN = 2
 #: and the checker found a consistency violation) and from unknown
 #: (the checker could not decide). See history/sentry.py.
 EXIT_HOSTILE_HISTORY = 3
+#: `lint` found non-baselined planelint findings (distinct from every
+#: verdict code so CI can tell "dirty tree" from "invalid history")
+EXIT_LINT_DIRTY = 5
 EXIT_CRASH = 254
 EXIT_USAGE = 255
 
@@ -383,10 +386,52 @@ def _engine_stats() -> dict:
     from jepsen_tpu.checker.streaming import stream_stats
 
     return {
-        "launch": dict(bs.LAUNCH_STATS),
+        "launch": bs.launch_stats_snapshot(),
         "checkpoint": checkpoint_stats(),
         "streaming": stream_stats(),
     }
+
+
+def cmd_lint(args) -> int:
+    """Run planelint (jepsen_tpu/analysis) over the package tree.
+
+    Exit 0 when every finding is inline-suppressed or baselined, 5
+    when non-baselined findings remain. --update-baseline rewrites
+    planelint_baseline.json with the current findings (grandfathering
+    them); --json emits the machine-readable report the CI preflight
+    parses. Stdlib-ast only: no jax import, so it runs anywhere."""
+    import json
+
+    from jepsen_tpu import analysis
+
+    root = args.root or analysis.package_root()
+    baseline_path = args.baseline or analysis.default_baseline_path()
+    findings = analysis.run_lint(root)
+    if args.update_baseline:
+        analysis.save_baseline(baseline_path, findings)
+        print(
+            f"planelint: baselined {len(findings)} finding(s) into "
+            f"{baseline_path}"
+        )
+        return EXIT_VALID
+    baseline = analysis.load_baseline(baseline_path)
+    new, matched = analysis.apply_baseline(findings, baseline)
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in new],
+            "baselined": sum(matched.values()),
+            "total": len(findings),
+            "clean": not new,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        print(
+            f"planelint: {len(new)} finding(s) "
+            f"({sum(matched.values())} baselined, "
+            f"{len(findings)} total)"
+        )
+    return EXIT_LINT_DIRTY if new else EXIT_VALID
 
 
 def cmd_serve(args) -> int:
@@ -506,6 +551,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "resilience/checkpoint, the /stats shape) as "
                         "JSON to PATH ('-' = stdout)")
     a.set_defaults(fn=cmd_analyze)
+
+    ln = sub.add_parser(
+        "lint",
+        help="planelint: static hot-path/lock-discipline analysis "
+             "over the package (exit 0 clean, 5 findings)",
+    )
+    ln.add_argument("--root", default=None,
+                    help="package tree to lint (default: the "
+                         "installed jepsen_tpu package)")
+    ln.add_argument("--baseline", default=None, metavar="PATH",
+                    help="baseline file (default: "
+                         "planelint_baseline.json at the repo root)")
+    ln.add_argument("--json", action="store_true",
+                    help="machine-readable findings report")
+    ln.add_argument("--update-baseline", action="store_true",
+                    help="grandfather the current findings into the "
+                         "baseline instead of failing on them")
+    ln.set_defaults(fn=cmd_lint)
 
     s = sub.add_parser("serve", help="web dashboard over the store")
     shared(s)

@@ -72,6 +72,7 @@ def install_signal_drain(
             return
         handle.signum = signum
         handle.triggered.set()
+        # planelint: disable=JT203 reason=the drain thread is launched FROM a signal handler, which must return immediately; serve_forever's shutdown path is the join seam
         threading.Thread(
             target=on_drain, args=(signum,), daemon=True,
             name="graceful-drain",

@@ -398,8 +398,8 @@ class CheckerDaemon:
             return 400, {"error": "bad-request", "detail": str(e)}
         key = (tenant, stream_id)
         with self._streams_lock:
-            sc = self._streams.get(key)
-            if sc is None:
+            ent = self._streams.get(key)
+            if ent is None:
                 path = None
                 if req.get("durable"):
                     self.ledger.note(tenant, "durable_checks")
@@ -412,14 +412,19 @@ class CheckerDaemon:
                     interpret=self.interpret,
                     path=path,
                 )
-                self._streams[key] = sc
+                ent = (sc, threading.Lock())
+                self._streams[key] = ent
+        sc, sc_lock = ent
         try:
             with dispatch.tenant_context(tenant):
-                # The handle is single-writer by lock: concurrent
-                # chunks of one stream serialize here; distinct
-                # streams proceed in parallel.
-                with self._streams_lock:
+                # Single-writer per STREAM: concurrent chunks of one
+                # stream serialize on the stream's own lock. The
+                # global registry lock is released first — holding it
+                # across the device launch (planelint JT202) stalled
+                # every other tenant's streams behind this chunk.
+                with sc_lock:
                     status = sc.append(ops) if ops else sc.status()
+                    # planelint: disable=JT202 reason=sc.result is the stream verdict computation, not a Future wait; the per-stream lock is held across it BY DESIGN (single-writer: only the same stream's next chunk contends)
                     out = sc.result() if final else None
         except Exception as e:  # noqa: BLE001 - the exit-2 analog
             log.exception("stream chunk failed (tenant=%s)", tenant)

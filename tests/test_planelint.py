@@ -1,0 +1,579 @@
+"""planelint tests: the per-rule corpus (every rule fires exactly
+once on its positive snippet and never on the sanctioned negative),
+the suppression and baseline machinery, the CLI exit-code contract,
+the repo-clean tier-1 gate, and the runtime side of the JT204 fix
+(chaos quarantine hooks run outside the stats lock)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from jepsen_tpu import analysis
+from jepsen_tpu.analysis import (
+    Finding,
+    apply_baseline,
+    lint_source,
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+
+pytestmark = pytest.mark.lint
+
+
+# --------------------------------------------------------------------
+# Rule corpus: (positive, negative) per rule. The positive must yield
+# EXACTLY one finding, of exactly that rule; the negative — the
+# sanctioned spelling of the same operation — must lint clean.
+# --------------------------------------------------------------------
+
+CASES = {
+    "JT001": (
+        # bare suppression: waives an invariant without saying why
+        """
+def f():
+    x = 1.0  # planelint: disable=JT101
+    return x
+""",
+        """
+def f():
+    x = 1.0  # planelint: disable=JT101 reason=corpus negative
+    return x
+""",
+    ),
+    "JT101": (
+        # host coercion of a device value outside the funnel
+        """
+import jax.numpy as jnp
+
+def f():
+    x = jnp.sum(jnp.arange(4))
+    return float(x)
+""",
+        """
+import jax.numpy as jnp
+
+def f():
+    x = jnp.sum(jnp.arange(4))
+    return float(_host_get(x))
+""",
+    ),
+    "JT102": (
+        """
+def f(x):
+    x.block_until_ready()
+    return x
+""",
+        """
+def f(x):
+    return _host_get(x)
+""",
+    ),
+    "JT103": (
+        # dispatch of a jitted callable with no launch accounting
+        """
+import jax
+
+def _impl(a):
+    return a
+
+scan = jax.jit(_impl)
+
+def f(a):
+    return scan(a)
+""",
+        """
+import jax
+
+def _impl(a):
+    return a
+
+scan = jax.jit(_impl)
+
+def f(a):
+    _bump_launch("launches")
+    return scan(a)
+""",
+    ),
+    "JT104": (
+        """
+import jax
+
+def f(x):
+    return jax.device_get(x)
+""",
+        """
+import jax
+
+def f(x):
+    return resilient_call(lambda: jax.device_get(x), site="launch")
+""",
+    ),
+    "JT105": (
+        # reading a buffer after donating it to a donate_argnums callee
+        """
+import functools
+
+import jax
+
+def _impl(a, fr):
+    return fr
+
+run = functools.partial(jax.jit, donate_argnums=(1,))(_impl)
+
+def f(a, fr):
+    _bump_launch("launches")
+    out = run(a, fr)
+    return fr
+""",
+        """
+import functools
+
+import jax
+
+def _impl(a, fr):
+    return fr
+
+run = functools.partial(jax.jit, donate_argnums=(1,))(_impl)
+
+def f(a, fr):
+    _bump_launch("launches")
+    out = run(a, fr)
+    return out
+""",
+    ),
+    "JT106": (
+        """
+import jax
+
+@jax.jit
+def f(x, opts={}):
+    return x
+""",
+        """
+import jax
+
+@jax.jit
+def f(x, opts=None):
+    return x
+""",
+    ),
+    "JT201": (
+        """
+CORPUS_STATS = {"hits": 0}
+
+def f():
+    CORPUS_STATS["hits"] += 1
+""",
+        """
+import threading
+
+CORPUS_STATS = {"hits": 0}
+_lock = threading.Lock()
+
+def f():
+    with _lock:
+        CORPUS_STATS["hits"] += 1
+""",
+    ),
+    "JT202": (
+        """
+import threading
+import time
+
+_lock = threading.Lock()
+
+def f():
+    with _lock:
+        time.sleep(0.1)
+""",
+        """
+import threading
+import time
+
+_lock = threading.Lock()
+
+def f():
+    with _lock:
+        n = 1
+    time.sleep(0.1)
+""",
+    ),
+    "JT203": (
+        """
+import threading
+
+def f():
+    threading.Thread(target=print, daemon=True).start()
+""",
+        """
+import threading
+
+def f():
+    t = threading.Thread(target=print)
+    t.start()
+    t.join(timeout=1.0)
+""",
+    ),
+    "JT204": (
+        """
+import threading
+
+_lock = threading.Lock()
+
+def fire(on_fault):
+    with _lock:
+        on_fault("dev0")
+""",
+        """
+import threading
+
+_lock = threading.Lock()
+
+def fire(on_fault):
+    with _lock:
+        label = "dev0"
+    on_fault(label)
+""",
+    ),
+    "JT205": (
+        """
+CORPUS_STATS = {"hits": 0}
+
+def f():
+    return dict(CORPUS_STATS)
+""",
+        """
+import threading
+
+CORPUS_STATS = {"hits": 0}
+_lock = threading.Lock()
+
+def snapshot():
+    with _lock:
+        return dict(CORPUS_STATS)
+""",
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(CASES))
+def test_rule_fires_exactly_once(rule):
+    pos, _ = CASES[rule]
+    found = lint_source(pos, rel="checker/corpus.py")
+    assert [f.rule for f in found] == [rule], (
+        f"{rule} positive produced {[f.render() for f in found]}"
+    )
+
+
+@pytest.mark.parametrize("rule", sorted(CASES))
+def test_rule_negative_is_clean(rule):
+    _, neg = CASES[rule]
+    found = lint_source(neg, rel="checker/corpus.py")
+    assert found == [], (
+        f"{rule} negative produced {[f.render() for f in found]}"
+    )
+
+
+def test_rule_catalog_covers_corpus():
+    # every corpus rule is documented, and vice versa (JT000 is the
+    # parse-failure escape hatch, not a documented rule)
+    assert set(CASES) == set(analysis.RULES)
+
+
+def test_host_get_funnel_itself_is_exempt():
+    # the ONE sanctioned crossing must not be flagged for being itself
+    src = """
+import jax
+
+def _bump_launch(key):
+    pass
+
+def _host_get(x):
+    _bump_launch("host_syncs")
+    return jax.device_get(x)
+"""
+    assert lint_source(src, rel="checker/corpus.py") == []
+
+
+def test_traced_helpers_are_exempt():
+    # helpers reachable from a jit impl run under tracing, where a
+    # comparison builds a device expression instead of syncing
+    src = """
+import jax
+import jax.numpy as jnp
+
+def _helper(a):
+    return jnp.where(a > 0, a, -a)
+
+def _impl(a):
+    return _helper(a)
+
+scan = jax.jit(_impl)
+"""
+    assert lint_source(src, rel="checker/corpus.py") == []
+
+
+# --------------------------------------------------------------------
+# Suppressions
+# --------------------------------------------------------------------
+
+
+def test_trailing_suppression_silences_its_line():
+    src = """
+import jax.numpy as jnp
+
+def f():
+    x = jnp.sum(jnp.arange(4))
+    return float(x)  # planelint: disable=JT101 reason=corpus
+"""
+    assert lint_source(src, rel="checker/corpus.py") == []
+
+
+def test_standalone_suppression_governs_next_line():
+    src = """
+import jax.numpy as jnp
+
+def f():
+    x = jnp.sum(jnp.arange(4))
+    # planelint: disable=JT101 reason=corpus
+    return float(x)
+"""
+    assert lint_source(src, rel="checker/corpus.py") == []
+
+
+def test_suppression_is_rule_specific():
+    # disabling a DIFFERENT rule must not silence the finding
+    src = """
+import jax.numpy as jnp
+
+def f():
+    x = jnp.sum(jnp.arange(4))
+    return float(x)  # planelint: disable=JT102 reason=wrong rule
+"""
+    found = lint_source(src, rel="checker/corpus.py")
+    assert [f.rule for f in found] == ["JT101"]
+
+
+def test_multi_rule_suppression():
+    src = """
+import jax
+
+def f(x):
+    return jax.device_get(x)  # planelint: disable=JT104,JT101 reason=corpus
+"""
+    assert lint_source(src, rel="checker/corpus.py") == []
+
+
+# --------------------------------------------------------------------
+# Baseline round trip
+# --------------------------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    pos, _ = CASES["JT101"]
+    found = lint_source(pos, rel="checker/corpus.py")
+    path = os.path.join(tmp_path, "baseline.json")
+    save_baseline(path, found)
+    baseline = load_baseline(path)
+    assert baseline == {"checker/corpus.py::f::JT101": 1}
+    new, matched = apply_baseline(found, baseline)
+    assert new == []
+    assert matched == {"checker/corpus.py::f::JT101": 1}
+
+
+def test_baseline_counts_are_a_budget_not_a_waiver():
+    # two same-key findings against a grandfathered count of one:
+    # exactly one stays new — the baseline can never grow silently
+    src = """
+import jax.numpy as jnp
+
+def f():
+    x = jnp.sum(jnp.arange(4))
+    y = jnp.sum(jnp.arange(5))
+    return float(x) + float(y)
+"""
+    found = lint_source(src, rel="checker/corpus.py")
+    assert len(found) == 2
+    new, matched = apply_baseline(
+        found, {"checker/corpus.py::f::JT101": 1}
+    )
+    assert len(new) == 1 and new[0].rule == "JT101"
+    assert matched == {"checker/corpus.py::f::JT101": 1}
+
+
+def test_missing_baseline_file_is_empty():
+    assert load_baseline("/nonexistent/baseline.json") == {}
+
+
+# --------------------------------------------------------------------
+# CLI contract + the repo-clean tier-1 gate
+# --------------------------------------------------------------------
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu.cli", "lint", *args],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_repo_lints_clean_against_checked_in_baseline():
+    """THE gate: the tree must carry zero non-baselined findings.
+    In-process (no subprocess) so a failure renders the findings."""
+    findings = run_lint()
+    baseline = load_baseline(analysis.default_baseline_path())
+    new, _ = apply_baseline(findings, baseline)
+    assert new == [], "non-baselined planelint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+
+
+def test_cli_json_contract():
+    proc = _run_cli("--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout)
+    assert rec["clean"] is True
+    assert rec["findings"] == []
+
+
+def test_cli_exit_codes_on_dirty_tree(tmp_path):
+    pkg = tmp_path / "checker"
+    pkg.mkdir()
+    dirty = pkg / "streaming.py"
+    dirty.write_text(CASES["JT104"][0])
+    baseline = str(tmp_path / "baseline.json")
+    # dirty + no baseline: exit 5 (EXIT_LINT_DIRTY), finding rendered
+    proc = _run_cli("--root", str(tmp_path), "--baseline", baseline)
+    assert proc.returncode == 5, proc.stdout + proc.stderr
+    assert "JT104" in proc.stdout
+    # grandfather it, then the same tree is clean
+    proc = _run_cli(
+        "--root", str(tmp_path), "--baseline", baseline,
+        "--update-baseline",
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = _run_cli("--root", str(tmp_path), "--baseline", baseline)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_checked_in_baseline_is_valid():
+    # the committed file parses, and carries only known rule keys
+    baseline = load_baseline(analysis.default_baseline_path())
+    for key, count in baseline.items():
+        assert count > 0
+        rule = key.rsplit("::", 1)[-1]
+        assert rule in analysis.RULES or rule == "JT000"
+
+
+# --------------------------------------------------------------------
+# Satellite regressions: the findings fixed in this tree stay fixed
+# --------------------------------------------------------------------
+
+
+def _lint_module(relpath, families):
+    root = analysis.package_root()
+    with open(os.path.join(root, relpath)) as f:
+        return lint_source(f.read(), rel=relpath, families=families)
+
+
+def test_chaos_module_has_no_under_lock_hook_invocation():
+    # JT204 regression for the quarantine-hook seam (satellite: hooks
+    # fire after _stats_lock release, never under it)
+    found = _lint_module("checker/chaos.py", families=("B",))
+    assert [f for f in found if f.rule == "JT204"] == []
+
+
+def test_dispatch_plane_reads_launch_stats_through_snapshot():
+    # JT205 regression: every aggregate stats read in the dispatch
+    # plane and the CLI rides the locked snapshot helpers
+    for rel in ("checker/dispatch.py", "cli.py"):
+        found = _lint_module(rel, families=("B",))
+        assert [f for f in found if f.rule == "JT205"] == [], rel
+
+
+def test_server_streams_do_not_block_under_global_lock():
+    # JT202 regression: stream chunks serialize on per-stream locks,
+    # never across the global registry lock
+    found = _lint_module("service/server.py", families=("B",))
+    assert [f for f in found if f.rule == "JT202"] == []
+
+
+def test_dispatch_snapshot_shape():
+    from jepsen_tpu.checker import dispatch, wgl_bitset as bs
+
+    snap = dispatch.snapshot()
+    assert set(snap) == {"dispatch", "per_device", "launch"}
+    assert set(snap["launch"]) == set(bs.launch_stats_snapshot())
+    assert "host_syncs" in snap["launch"]
+    # dispatch_stats() is derived from the same snapshot
+    stats = dispatch.dispatch_stats()
+    assert set(stats["launch"]) == set(snap["launch"])
+
+
+# --------------------------------------------------------------------
+# Runtime side of the JT204 fix: chaos quarantine hooks
+# --------------------------------------------------------------------
+
+
+def _forget_label(chaos, label):
+    with chaos._stats_lock:
+        chaos._DEVICE_FAILURES.pop(label, None)
+        if label in chaos._QUARANTINED:
+            chaos._QUARANTINED.remove(label)
+
+
+@pytest.mark.chaos
+def test_quarantine_hook_runs_outside_stats_lock():
+    from jepsen_tpu.checker import chaos
+
+    label = "corpus-hook-dev"
+    seen = []
+
+    def hook(lbl):
+        # the hook may re-enter the stats API: is_quarantined takes
+        # _stats_lock, which would deadlock if the caller still held
+        # it (the JT204 failure mode)
+        seen.append(
+            (lbl, chaos._stats_lock.locked(), chaos.is_quarantined(lbl))
+        )
+
+    chaos.add_quarantine_hook(hook)
+    try:
+        assert not chaos.note_device_failure(label, quarantine_after=3)
+        assert not chaos.note_device_failure(label, quarantine_after=3)
+        assert seen == []  # below the threshold: no hook
+        assert chaos.note_device_failure(label, quarantine_after=3)
+        assert seen == [(label, False, True)]
+        # already quarantined: never trips (or fires hooks) again
+        assert not chaos.note_device_failure(label, quarantine_after=3)
+        assert seen == [(label, False, True)]
+    finally:
+        chaos.remove_quarantine_hook(hook)
+        _forget_label(chaos, label)
+
+
+@pytest.mark.chaos
+def test_quarantine_hook_exception_does_not_break_accounting():
+    from jepsen_tpu.checker import chaos
+
+    label = "corpus-bad-hook-dev"
+
+    def bad_hook(lbl):
+        raise RuntimeError("observer boom")
+
+    chaos.add_quarantine_hook(bad_hook)
+    try:
+        for _ in range(2):
+            chaos.note_device_failure(label, quarantine_after=3)
+        # the trip still reports True and the ledger still records it
+        assert chaos.note_device_failure(label, quarantine_after=3)
+        assert chaos.is_quarantined(label)
+    finally:
+        chaos.remove_quarantine_hook(bad_hook)
+        _forget_label(chaos, label)

@@ -93,6 +93,11 @@ def test_graft_entry_contract(capfd):
     # publishes integer zeros (nonzero means faults were survived).
     assert isinstance(rec["retries"], int) and rec["retries"] >= 0
     assert isinstance(rec["quarantines"], int) and rec["quarantines"] >= 0
+    # Static-analysis validity rides the same line: the tree that
+    # produced this number carries zero non-baselined planelint
+    # findings (hot-path residency + lock discipline hold at review
+    # time, not just at runtime).
+    assert rec["lint_findings"] == 0
 
 
 def test_sharded_at_scale_with_escalation_keys():
