@@ -427,6 +427,7 @@ def _register_plane_pipelined(etcd, zk, ns, interpret=False):
     from jepsen_tpu.checker.events import clear_memos
     from jepsen_tpu.checker.linearizable import _on_tpu
     from jepsen_tpu.checker.models import model as get_model
+    from jepsen_tpu.obs import trace as obs_trace
 
     if not (_on_tpu() or interpret):
         return None
@@ -441,6 +442,14 @@ def _register_plane_pipelined(etcd, zk, ns, interpret=False):
     for s in etcd + zk + [ns]:
         clear_memos(s)
     reset_dispatch_stats()
+    # Flight recorder on for the suite pass (a few dozen events —
+    # noise against multi-second walls): the cross-check below
+    # recomputes the plane's derived ratios purely from spans and
+    # asserts they match the hand-computed dispatch stats, so a
+    # regression in either accounting path fails the bench.
+    trace_was_on = obs_trace.TRACER.enabled
+    obs_trace.TRACER.reset()
+    obs_trace.enable()
     # Residency deltas, snapshot-not-reset: LAUNCH_STATS is cumulative
     # across the whole bench (engine_stats publishes it), so the
     # pipelined pass measures itself by differencing around the run.
@@ -466,6 +475,34 @@ def _register_plane_pipelined(etcd, zk, ns, interpret=False):
         walls["northstar-100k"] = time.perf_counter() - t0
     ok = all(o["valid?"] for o in etcd_out + zk_out + [ns_out])
     dstats = dispatch_stats()
+    evs = obs_trace.spans()
+    if not trace_was_on:
+        obs_trace.disable()
+    # Span-derived ratios must equal the counter-derived ones exactly
+    # (same integers, same arithmetic — any drift means an emission
+    # site and a _bump site came apart).
+    t_batches = sum(1 for e in evs if e["name"] == "dispatch_batch")
+    t_solos = sum(1 for e in evs if e["name"] == "dispatch_solo")
+    t_riders = sum(e["args"]["riders"] for e in evs
+                   if e["name"] == "dispatch_batch")
+    t_regs = [e["args"]["inflight"] for e in evs
+              if e["name"] == "train_register"]
+    t_launches = t_batches + t_solos
+    t_floor = (t_riders + t_solos) / t_launches if t_launches else 0.0
+    t_occ = sum(t_regs) / len(t_regs) if t_regs else 0.0
+    assert abs(t_floor - dstats["floor_amortization"]) < 1e-9, (
+        f"trace floor_amortization {t_floor} != "
+        f"dispatch {dstats['floor_amortization']}"
+    )
+    assert abs(t_occ - dstats["double_buffer_occupancy"]) < 1e-9, (
+        f"trace double_buffer_occupancy {t_occ} != "
+        f"dispatch {dstats['double_buffer_occupancy']}"
+    )
+    dstats["trace_crosscheck"] = {
+        "floor_amortization": t_floor,
+        "double_buffer_occupancy": t_occ,
+        "events": len(evs),
+    }
     n_checks = len(etcd) + len(zk) + 1
     syncs = bs.LAUNCH_STATS["host_syncs"] - l0.get("host_syncs", 0)
     dstats["residency"] = {

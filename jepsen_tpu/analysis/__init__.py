@@ -3,8 +3,9 @@
 Stdlib-ast only (no new dependencies, importable without jax): the
 rules encode at review time what PRs 2-8 enforce at runtime — the
 _host_get sync funnel, launch accounting, chaos guards, buffer
-donation discipline (Family A, JT1xx) and stats-lock / blocking-call
-/ hook discipline (Family B, JT2xx).
+donation discipline (Family A, JT1xx), stats-lock / blocking-call
+/ hook discipline (Family B, JT2xx), and flight-recorder emission
+discipline (Family C, JT3xx).
 
 Entry points: ``python -m jepsen_tpu.cli lint`` and
 ``jepsen_tpu.analysis.run_lint()``; see README "Static analysis".
@@ -13,6 +14,7 @@ Entry points: ``python -m jepsen_tpu.cli lint`` and
 from jepsen_tpu.analysis.engine import (  # noqa: F401
     FAMILY_A_FILES,
     FAMILY_B_FILES,
+    FAMILY_C_FILES,
     RULES,
     default_baseline_path,
     families_for,

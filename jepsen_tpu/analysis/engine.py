@@ -9,6 +9,10 @@ Two rule families over two file sets:
   dispatch plane, runtime, service daemon, chaos — where a stats
   write outside its lock or a blocking call under one breaks the
   accounting/fairness contracts the tier-1 suite pins.
+- Family C (JT3xx, ``obsrules``) runs over the flight-recorder-
+  instrumented tree — spans close via context manager, nothing
+  emits under a plane lock, and no obs call is reachable from
+  jit-traced code.
 
 ``run_lint`` walks the package, applies inline suppressions, and
 returns findings; the CLI layers the baseline on top.
@@ -29,6 +33,7 @@ from jepsen_tpu.analysis.findings import (
     parse_suppressions,
 )
 from jepsen_tpu.analysis.hotpath import check_hotpath
+from jepsen_tpu.analysis.obsrules import check_obs
 
 #: Family A: the hot-path residency set (paths relative to the
 #: jepsen_tpu package root, forward slashes)
@@ -51,6 +56,15 @@ FAMILY_B_FILES = (
     "checker/checkpoint.py",
     "runtime/core.py",
     "service/*.py",
+    "cli.py",
+)
+
+#: Family C: the flight-recorder emission-discipline set — every
+#: module that calls (or implements) obs.span/obs.instant
+FAMILY_C_FILES = (
+    "checker/*.py",
+    "service/*.py",
+    "obs/*.py",
     "cli.py",
 )
 
@@ -108,6 +122,21 @@ RULES: Dict[str, Tuple[str, str]] = {
         "unlocked aggregate stats read",
         "aggregate stats reads go through a locked snapshot() helper",
     ),
+    "JT301": (
+        "span not context-managed",
+        "span(...) is always entered via with — a held span "
+        "silently drops its event",
+    ),
+    "JT302": (
+        "trace emission under plane lock",
+        "span/instant emission happens after every plane lock is "
+        "released",
+    ),
+    "JT303": (
+        "obs call in jit-traced code",
+        "no obs emission is reachable from jax tracing — trace-time "
+        "clock reads bake into the jit cache",
+    ),
 }
 
 
@@ -134,13 +163,15 @@ def families_for(rel: str) -> Tuple[str, ...]:
         fams.append("A")
     if _match(rel, FAMILY_B_FILES):
         fams.append("B")
+    if _match(rel, FAMILY_C_FILES):
+        fams.append("C")
     return tuple(fams)
 
 
 def lint_source(
     source: str,
     rel: str = "<corpus>",
-    families: Sequence[str] = ("A", "B"),
+    families: Sequence[str] = ("A", "B", "C"),
 ) -> List[Finding]:
     """Lint one source string (the tests' corpus entry and the
     per-file worker behind run_lint)."""
@@ -162,6 +193,8 @@ def lint_source(
         findings.extend(check_hotpath(tree, rel))
     if "B" in families:
         findings.extend(check_concurrency(tree, rel))
+    if "C" in families:
+        findings.extend(check_obs(tree, rel))
     suppressed, bare = parse_suppressions(source)
     findings = apply_suppressions(findings, suppressed)
     findings.extend(bare_suppression_findings(rel, bare))

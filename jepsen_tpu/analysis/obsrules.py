@@ -1,0 +1,163 @@
+"""planelint Family C: flight-recorder emission discipline.
+
+JT3xx rules over the instrumented tree (checker modules, the service
+daemon, the CLI, and ``obs`` itself). The recorder is deliberately
+safe to leave in hot paths — but only under three disciplines the
+runtime cannot enforce:
+
+- JT301 ``span(...)`` must be entered via ``with`` — a span records
+  itself at ``__exit__``, so a span held in a variable and never
+  (or conditionally) closed silently drops its event, and an
+  exception between ``__enter__`` and ``__exit__`` loses the timing.
+- JT302 no ``span``/``instant`` emission while holding a plane lock:
+  emission appends to a ring and (first emission per thread) takes
+  the ring-registry lock — doing that under ``_stats_lock`` couples
+  the recorder's locking to the plane's, and a slow trim stalls
+  every thread contending for the plane lock.
+- JT303 no ``span``/``instant`` call reachable from jit-traced code:
+  a traced emission fires at TRACE time, records compile-side wall,
+  and its clock read bakes into the jit cache — the timeline would
+  show phantom events that never happen on re-execution.
+
+Lock-scope inference matches Family B (``with <...lock...>:``), and
+traced-closure inference reuses Family A's ``ModuleInfo`` fixpoint.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from jepsen_tpu.analysis.findings import Finding
+from jepsen_tpu.analysis.hotpath import ModuleInfo, _last_seg
+
+#: emission entry points, by final name segment (``span``,
+#: ``obs_trace.span``, ``obs.instant``...)
+_SPAN_TAILS = {"span"}
+_EMIT_TAILS = {"span", "instant"}
+
+
+def _is_emit_call(node: ast.Call, tails: Set[str]) -> bool:
+    seg = _last_seg(node.func)
+    return bool(seg) and seg in tails
+
+
+class ObsChecker(ast.NodeVisitor):
+    def __init__(self, tree: ast.Module, rel: str):
+        self.tree = tree
+        self.rel = rel
+        self.findings: List[Finding] = []
+        self.locks: List[str] = []
+        self.symbols: List[str] = []
+        self.info = ModuleInfo(tree)
+        #: span(...) calls that ARE a with-item context expression
+        #: (the sanctioned spelling) — collected up front so JT301
+        #: can flag every other span call
+        self.with_spans: Set[int] = set()
+        for n in ast.walk(tree):
+            if isinstance(n, ast.With):
+                for item in n.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        self.with_spans.add(id(item.context_expr))
+        #: are we inside a function that only runs under jax tracing?
+        self.traced_depth = 0
+
+    @property
+    def symbol(self) -> str:
+        return ".".join(self.symbols) if self.symbols else "<module>"
+
+    def add(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(
+            Finding(
+                rule=rule,
+                file=self.rel,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                severity="error",
+                message=message,
+                symbol=self.symbol,
+            )
+        )
+
+    def run(self) -> List[Finding]:
+        self.visit(self.tree)
+        return self.findings
+
+    # -- scope tracking (Family B's lock discipline) -------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.symbols.append(node.name)
+        held, self.locks = self.locks, []
+        traced = (
+            node.name in self.info.traced
+            or node.name in self.info.jit_impls
+            or node.name in self.info.jitted
+        )
+        self.traced_depth += 1 if traced else 0
+        self.generic_visit(node)
+        self.traced_depth -= 1 if traced else 0
+        self.locks = held
+        self.symbols.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.symbols.append(node.name)
+        self.generic_visit(node)
+        self.symbols.pop()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        held, self.locks = self.locks, []
+        self.generic_visit(node)
+        self.locks = held
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            if (
+                _last_seg(item.context_expr) is not None
+                and "lock" in (_last_seg(item.context_expr) or "").lower()
+            ):
+                acquired.append(_last_seg(item.context_expr) or "<lock>")
+            else:
+                self.visit(item.context_expr)
+        self.locks.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.locks.pop()
+
+    # -- the rules -----------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_emit_call(node, _SPAN_TAILS) and (
+            id(node) not in self.with_spans
+        ):
+            self.add(
+                "JT301", node,
+                "span(...) not entered via a with block — the span "
+                "records itself at __exit__, so a held or "
+                "conditionally-closed span silently drops its event",
+            )
+        if _is_emit_call(node, _EMIT_TAILS):
+            if self.locks:
+                held = ", ".join(self.locks)
+                self.add(
+                    "JT302", node,
+                    f"trace emission while holding {held} — emit "
+                    "after the lock is released (emission may take "
+                    "the recorder's ring-registry lock and trim)",
+                )
+            if self.traced_depth > 0:
+                self.add(
+                    "JT303", node,
+                    "obs emission reachable from jit-traced code — "
+                    "it fires at trace time and its clock read bakes "
+                    "into the jit cache; emit from the host-side "
+                    "caller instead",
+                )
+        self.generic_visit(node)
+
+
+def check_obs(tree: ast.Module, rel: str) -> List[Finding]:
+    return ObsChecker(tree, rel).run()

@@ -55,6 +55,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence
 
+from jepsen_tpu.obs import trace as obs_trace
+
 # --------------------------------------------------------------------
 # Structured failures
 # --------------------------------------------------------------------
@@ -398,6 +400,8 @@ def resilient_call(
             if kind in _RETRYABLE and attempt < policy.max_retries:
                 with _stats_lock:
                     RESILIENCE_STATS["retries"] += 1
+                obs_trace.instant("retry", kind="chaos", site=site,
+                                  fault=kind, attempt=attempt + 1)
                 time.sleep(policy.delay(attempt))
                 attempt += 1
                 continue
@@ -510,6 +514,7 @@ def note_device_failure(label: str, quarantine_after: int = 3) -> bool:
         if tripped:
             _QUARANTINED.append(label)
     if tripped:
+        obs_trace.instant("quarantine", kind="chaos", device=label)
         # snapshot the hook list under its lock, then invoke AFTER
         # every lock is released (planelint JT204) — a hook that
         # re-enters the stats API must not find _stats_lock held

@@ -46,6 +46,8 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
+from jepsen_tpu.obs import trace as obs_trace
+
 #: bump when the payload layout changes — old files reject to cold runs
 VERSION = 1
 
@@ -205,10 +207,15 @@ class CheckpointSink:
                 if st.get("verdict") is not None:
                     self.replayed = True
                     _bump("replays")
+                    obs_trace.instant("checkpoint_replay",
+                                      kind="checkpoint")
                 elif st.get("segments_done", 0) > 0:
                     self.resumed_from = int(st["segments_done"])
                     _bump("resumes")
                     _bump("resumed_segments", self.resumed_from)
+                    obs_trace.instant("checkpoint_resume",
+                                      kind="checkpoint",
+                                      segments=self.resumed_from)
             self._state = st
             return st
         finally:
@@ -233,6 +240,8 @@ class CheckpointSink:
         escalation so a kill mid-exact-pass resumes on the exact
         tier, not back on fast."""
         _bump("invalidations")
+        obs_trace.instant("checkpoint_invalidate", kind="checkpoint",
+                          reason=reason)
         st = self._state
         st["segments_done"] = 0
         st["frontier"] = None
@@ -268,7 +277,9 @@ class CheckpointSink:
         t0 = time.perf_counter()
         st = dict(self._state)
         st["payload_sha"] = _payload_sha(st)
-        atomic_write_text(self.path, json.dumps(st))
+        with obs_trace.span("checkpoint_save", kind="checkpoint",
+                            segments=st.get("segments_done", 0)):
+            atomic_write_text(self.path, json.dumps(st))
         _bump("saves")
         _bump("overhead_s", time.perf_counter() - t0)
         if self.after_save is not None:

@@ -108,6 +108,7 @@ from jepsen_tpu.checker.linearizable import (
     check_events_bucketed,
 )
 from jepsen_tpu.checker.models import model as get_model
+from jepsen_tpu.obs import trace as obs_trace
 
 #: plane-level dispatch accounting (launch-level counts live in
 #: wgl_bitset.LAUNCH_STATS): "requests" = submissions accepted,
@@ -509,6 +510,8 @@ class DispatchPlane:
         fut = CheckFuture(self, events, model or self.model)
         fut.checkpoint = checkpoint
         _bump("requests")
+        obs_trace.instant("submit", kind="dispatch",
+                          tenant=current_tenant())
         if self._worker is not None:
             with self._lock:
                 self._inbox.append(fut)
@@ -883,6 +886,10 @@ class DispatchPlane:
             pending = [L for L in self._launched if not L.resolved]
         _bump("train_registers")
         _bump("train_inflight_accum", len(pending))
+        # inflight mirrors train_inflight_accum's bump, so occupancy is
+        # recomputable from the trace alone (bench cross-check)
+        obs_trace.instant("train_register", kind="dispatch",
+                          inflight=len(pending))
         for f in launch.futs:
             f.launch = launch
         for f in launch.futs:
@@ -1069,13 +1076,18 @@ class DispatchPlane:
             DISPATCH_STATS["max_batch"] = max(
                 DISPATCH_STATS["max_batch"], len(b.futs)
             )
+        obs_trace.instant("dispatch_batch", kind="dispatch",
+                          riders=len(b.futs), wait_us=wait_us,
+                          bucket=key[0])
         try:
-            if key[0] == "bitset":
-                self._dispatch_bitset_batch(b.futs, key)
-            elif key[0] == "graph":
-                self._dispatch_graph_batch(b.futs, key)
-            else:
-                self._dispatch_vmap_batch(b.futs, key)
+            with obs_trace.span("dispatch", kind="dispatch",
+                                bucket=key[0], riders=len(b.futs)):
+                if key[0] == "bitset":
+                    self._dispatch_bitset_batch(b.futs, key)
+                elif key[0] == "graph":
+                    self._dispatch_graph_batch(b.futs, key)
+                else:
+                    self._dispatch_vmap_batch(b.futs, key)
         except BaseException as e:  # noqa: BLE001
             for f in b.futs:
                 f._fail(e)
@@ -1221,6 +1233,8 @@ class DispatchPlane:
 
     def _dispatch_segmented(self, fut: CheckFuture) -> None:
         _bump("solo_launches")
+        obs_trace.instant("dispatch_solo", kind="dispatch",
+                          tenant=fut.tenant)
         # Round-robin segmented chains across the mesh: independent
         # requests' chains execute concurrently on different chips,
         # each on its own per-device launch train (jit follows the
@@ -1333,15 +1347,18 @@ class DispatchPlane:
                 # the device->host copies, so by now the transfer has
                 # mostly overlapped newer launches' device work).
                 bs._bump_launch("host_syncs")
-                host = self._guard(
-                    "collect",
-                    lambda: jax.device_get(
-                        tuple(L.device_out() for L in prefix)
-                    ),
-                    self._labels(self.mesh) + _tenant_tags(
-                        [f for L in prefix for f in L.futs]
-                    ),
-                )
+                # planelint: disable=JT302 reason=the collect span MUST wrap the guarded device_get, and collectors are serialized under _collect_lock by design (single collector per train prefix); ring append is lock-free so no cross-lock coupling
+                with obs_trace.span("collect", kind="collect",
+                                    trains=len(prefix)):
+                    host = self._guard(
+                        "collect",
+                        lambda: jax.device_get(
+                            tuple(L.device_out() for L in prefix)
+                        ),
+                        self._labels(self.mesh) + _tenant_tags(
+                            [f for L in prefix for f in L.futs]
+                        ),
+                    )
             except PlaneFault as pf:
                 try:
                     for L in prefix:
@@ -1619,6 +1636,10 @@ class DispatchPlane:
             DISPATCH_STATS["max_batch"] = max(
                 DISPATCH_STATS["max_batch"], len(futs)
             )
+        obs_trace.instant("dispatch_batch", kind="dispatch",
+                          riders=len(futs), wait_us=0.0,
+                          bucket="bitset")
+
         def launch_with(m):
             return bs.launch_keys_bitset(
                 steps_list, model=name, S=S, interpret=interpret,

@@ -168,6 +168,21 @@ def reset_mesh_stats() -> None:
         }
 
 
+def mesh_stats_snapshot() -> dict:
+    """Locked copy of MESH_STATS (the resilience block holds a mutable
+    list, so a shallow copy would alias it)."""
+    with _mesh_stats_lock:
+        res = MESH_STATS["resilience"]
+        return {
+            "sharded_launches": MESH_STATS["sharded_launches"],
+            "last_n_devices": MESH_STATS["last_n_devices"],
+            "resilience": {
+                "quarantined_devices": list(res["quarantined_devices"]),
+                "resharded_launches": res["resharded_launches"],
+            },
+        }
+
+
 def mesh_size(mesh: Mesh) -> int:
     """Device count of a mesh = product over every axis (keys shard
     over the full product; see key_spec)."""

@@ -60,6 +60,7 @@ from jepsen_tpu.checker.events import (
     history_to_events,
 )
 from jepsen_tpu.checker.models import model as get_model
+from jepsen_tpu.obs import trace as obs_trace
 
 #: bump when the persisted stream-state layout changes
 VERSION = 1
@@ -243,8 +244,11 @@ class StreamingCheck:
         _bump("appends")
         if self._verdict is not None:
             return self.status()
+        n0 = len(self._ops)
         self._ops.extend(ops)
-        self._advance()
+        with obs_trace.span("stream_append", kind="streaming",
+                            n_ops=len(self._ops) - n0):
+            self._advance()
         return self.status()
 
     def status(self) -> dict:

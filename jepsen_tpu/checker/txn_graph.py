@@ -51,6 +51,8 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from jepsen_tpu.obs import trace as obs_trace
+
 #: dependency edge classes (Adya/Elle): wr = write-read (read-from),
 #: ww = write-write (version order), rw = read-write (anti-dependency)
 EDGE_CLASSES = ("wr", "ww", "rw")
@@ -102,6 +104,12 @@ def reset_txn_graph_stats() -> None:
 def _note(key: str, n: int = 1) -> None:
     with _stats_lock:
         TXN_GRAPH_STATS[key] += n
+
+
+def txn_graph_stats() -> dict:
+    """Locked copy for snapshot readers."""
+    with _stats_lock:
+        return dict(TXN_GRAPH_STATS)
 
 
 # -- columnar txn plane ------------------------------------------------------
@@ -1203,6 +1211,8 @@ def launch_graph_batch(wrww, allm, rw, need1: bool = True,
     n_iters = _n_iters(N)
     _note("matmul_rounds", n_iters * (int(need1) + int(need2)))
     _note("device_graphs", B)
+    obs_trace.instant("graph_batch", kind="txn_graph", graphs=B, n=N,
+                      rounds=n_iters)
     if mesh is not None:
         import jax
         from jax.sharding import NamedSharding

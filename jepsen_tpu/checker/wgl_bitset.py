@@ -73,6 +73,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from jepsen_tpu.checker.events import ReturnSteps, bucket, memo_on
 from jepsen_tpu.checker.models import model as get_model
+from jepsen_tpu.obs import trace as obs_trace
 
 # jax renamed TPUCompilerParams -> CompilerParams across releases;
 # accept either so the kernel runs on both sides of the rename.
@@ -409,6 +410,11 @@ _launch_stats_lock = threading.Lock()
 def _bump_launch(key: str, n: int = 1) -> None:
     with _launch_stats_lock:
         LAUNCH_STATS[key] += n
+    # flight-recorder mirror: one instant per bump, emitted AFTER the
+    # stats lock drops (planelint JT302). Instant counts per name equal
+    # the counter deltas exactly — the parity pin tests/test_obs.py
+    # and the analyze --trace acceptance check rely on this.
+    obs_trace.instant(key, kind="launch_stat", n=n)
 
 
 def reset_launch_stats() -> None:
@@ -435,7 +441,8 @@ def _host_get(x):
     the same computation already materialized (death artifacts, debug
     frontiers) use plain device_get/np.asarray — the floor was paid."""
     _bump_launch("host_syncs")
-    return jax.device_get(x)
+    with obs_trace.span("host_sync", kind="host_sync"):
+        return jax.device_get(x)
 
 
 def init_frontier(init_state, S: int, W: int) -> np.ndarray:

@@ -146,16 +146,14 @@ def _reset_engine_state() -> None:
     a sticky-shrunk default plane left by a prior in-process run (or
     an embedding test harness) must not shadow THIS run's mesh; stats
     reset so the engine_stats this command reports are its own."""
-    from jepsen_tpu.checker import chaos, dispatch
-    from jepsen_tpu.checker import wgl_bitset as bs
-    from jepsen_tpu.checker.checkpoint import reset_checkpoint_stats
-    from jepsen_tpu.checker.streaming import reset_stream_stats
+    from jepsen_tpu.checker import dispatch
+    from jepsen_tpu.obs.snapshot import reset_engine_stats
 
-    chaos.reset_resilience()
+    # one consolidated reset for every counter surface the snapshot
+    # reads (chaos/launch/dispatch/mesh/checkpoint/streaming/txn-graph
+    # plus the flight recorder's rings), then the plane itself
+    reset_engine_stats()
     dispatch.reset_default_plane()
-    bs.reset_launch_stats()
-    reset_checkpoint_stats()
-    reset_stream_stats()
 
 
 def cmd_test(args) -> int:
@@ -212,6 +210,29 @@ def _resolve_run_dir(path: str, store_root: str) -> str:
 
 
 def cmd_analyze(args) -> int:
+    """`analyze`, with the flight recorder wrapped around it when
+    --trace PATH is given: the tracer enables before any launch,
+    records every plane crossing the re-check makes, and exports a
+    Perfetto-loadable Chrome-trace JSON to PATH on the way out
+    (whatever the verdict — a crashed analysis still leaves its
+    trace). Feed the file to ui.perfetto.dev or `jepsen_tpu
+    trace-summary`."""
+    trace_path = getattr(args, "trace", None)
+    if not trace_path:
+        return _cmd_analyze(args)
+    from jepsen_tpu import obs
+
+    obs.enable()
+    try:
+        return _cmd_analyze(args)
+    finally:
+        events = obs.spans()
+        obs.write_chrome_trace(trace_path, events)
+        obs.disable()
+        print(f"trace: {len(events)} events -> {trace_path}")
+
+
+def _cmd_analyze(args) -> int:
     """Re-check a stored history — the checkpoint/resume seam for the
     analysis phase (cli.clj:366-397).
 
@@ -364,9 +385,7 @@ def _dump_stats_json(path: str) -> None:
     of parsing results.json out of the run dir."""
     import json
 
-    from jepsen_tpu.checker import dispatch
-
-    bundle = {"dispatch": dispatch.dispatch_stats(), **_engine_stats()}
+    bundle = _engine_stats()
     if path == "-":
         print(json.dumps(bundle, indent=2, default=str))
     else:
@@ -378,18 +397,67 @@ def _dump_stats_json(path: str) -> None:
 
 
 def _engine_stats() -> dict:
-    """Launch + checkpoint accounting for results.json — the cross-
+    """The consolidated engine snapshot for results.json — the cross-
     process audit trail the kill-restart differential reads (a
-    resumed run shows strictly fewer launches than the cold one)."""
-    from jepsen_tpu.checker import wgl_bitset as bs
-    from jepsen_tpu.checker.checkpoint import checkpoint_stats
-    from jepsen_tpu.checker.streaming import stream_stats
+    resumed run shows strictly fewer launches than the cold one).
+    Same shape the daemon's /stats serves and the dryrun metric line
+    summarizes: obs.snapshot.engine_snapshot() is the one reader."""
+    from jepsen_tpu.obs.snapshot import engine_snapshot
 
-    return {
-        "launch": bs.launch_stats_snapshot(),
-        "checkpoint": checkpoint_stats(),
-        "streaming": stream_stats(),
-    }
+    return engine_snapshot()
+
+
+def cmd_trace_summary(args) -> int:
+    """Attribution table from a Chrome-trace file (`analyze --trace`
+    output): where the wall went, by span kind and name — launch vs.
+    host-sync floor vs. coalesce holds — plus the two derived ratios
+    the dispatch plane reports (floor amortization from dispatch_batch/
+    dispatch_solo instants, double-buffer occupancy from train_register
+    instants), recomputed purely from the trace."""
+    import json
+
+    from jepsen_tpu.obs.export import validate_chrome_trace
+
+    with open(args.path) as f:
+        obj = json.load(f)
+    errors = validate_chrome_trace(obj)
+    if errors:
+        for e in errors[:10]:
+            print(f"trace-summary: schema: {e}")
+        return EXIT_UNKNOWN
+    evs = [e for e in obj["traceEvents"] if e["ph"] in ("X", "i")]
+    wall_ms = 0.0
+    if evs:
+        wall_ms = (max(e["ts"] + e.get("dur", 0) for e in evs)
+                   - min(e["ts"] for e in evs)) / 1e3
+    rows = {}
+    for e in evs:
+        key = (e.get("cat", "?"), e["name"])
+        cnt, tot = rows.get(key, (0, 0.0))
+        rows[key] = (cnt + 1, tot + e.get("dur", 0) / 1e3)
+    print(f"{'kind':<12} {'name':<24} {'count':>8} {'total_ms':>10} "
+          f"{'mean_ms':>9} {'%wall':>6}")
+    for (kind, name), (cnt, tot) in sorted(
+            rows.items(), key=lambda kv: -kv[1][1]):
+        pct = 100.0 * tot / wall_ms if wall_ms else 0.0
+        print(f"{kind:<12} {name:<24} {cnt:>8} {tot:>10.3f} "
+              f"{tot / cnt:>9.3f} {pct:>6.1f}")
+    batches = sum(1 for e in evs if e["name"] == "dispatch_batch")
+    solos = sum(1 for e in evs if e["name"] == "dispatch_solo")
+    riders = sum(e["args"].get("riders", 0) for e in evs
+                 if e["name"] == "dispatch_batch")
+    regs = [e["args"].get("inflight", 0) for e in evs
+            if e["name"] == "train_register"]
+    launches = batches + solos
+    if launches:
+        print(f"floor_amortization    "
+              f"{(riders + solos) / launches:.3f}  "
+              f"({riders + solos} requests / {launches} launches)")
+    if regs:
+        print(f"double_buffer_occupancy {sum(regs) / len(regs):.3f}  "
+              f"(over {len(regs)} trains)")
+    print(f"wall {wall_ms:.3f} ms, {len(evs)} events")
+    return EXIT_VALID
 
 
 def cmd_lint(args) -> int:
@@ -550,7 +618,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also write the engine-stats bundle (launch/"
                         "resilience/checkpoint, the /stats shape) as "
                         "JSON to PATH ('-' = stdout)")
+    a.add_argument("--trace", default=None, metavar="PATH",
+                   help="record every plane crossing with the flight "
+                        "recorder and export a Perfetto-loadable "
+                        "Chrome-trace JSON to PATH")
     a.set_defaults(fn=cmd_analyze)
+
+    ts = sub.add_parser(
+        "trace-summary",
+        help="attribution table (floor/occupancy, %%wall by span) "
+             "from an `analyze --trace` Chrome-trace file",
+    )
+    ts.add_argument("path", help="Chrome-trace JSON file")
+    ts.set_defaults(fn=cmd_trace_summary)
 
     ln = sub.add_parser(
         "lint",

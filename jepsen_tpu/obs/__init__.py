@@ -1,0 +1,42 @@
+"""obs: the flight-recorder observability plane.
+
+Stdlib-only span recorder + exporters for every plane crossing the
+engine makes (PR 12). The reference Jepsen renders latency graphs and
+an HTML timeline from its histories (`checker/perf.py`,
+`checker/timeline.py`); our analogue records the TPU plane's OWN
+crossings — launches, host syncs, coalesce holds, collect trains,
+checkpoint saves, chaos retries — as spans and exports them as
+industry-standard artifacts:
+
+- ``obs.trace``: process-wide per-thread ring-buffer recorder
+  (``span(...)`` context manager + ``instant(...)`` events, disabled
+  by default — the off path is one attribute check, safe in hot paths)
+- ``obs.export``: Chrome-trace/Perfetto JSON + JSONL sinks
+- ``obs.prom``: Prometheus text exposition folding in every ``*_STATS``
+  surface plus trace-derived latency histograms
+- ``obs.snapshot``: the ONE consolidated ``engine_snapshot()`` behind
+  ``cli._engine_stats``, the daemon's ``/stats``, and the dryrun
+  metric line (imported lazily — it pulls the jax-backed checker
+  modules, which this package root must not)
+
+planelint Family C (JT301-303) enforces the emission discipline:
+spans close via context manager, nothing emits under a plane lock,
+and no obs call is reachable from jit-traced code.
+"""
+
+from jepsen_tpu.obs.trace import (  # noqa: F401
+    TRACER,
+    disable,
+    enable,
+    instant,
+    reset,
+    span,
+    spans,
+    trace_stats,
+)
+from jepsen_tpu.obs.export import (  # noqa: F401
+    chrome_trace,
+    validate_chrome_trace,
+    write_chrome_trace,
+    write_jsonl,
+)

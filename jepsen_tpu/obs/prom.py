@@ -1,0 +1,111 @@
+"""Prometheus text exposition for the engine.
+
+Folds every ``*_STATS`` surface (via the consolidated
+``obs.snapshot.engine_snapshot()``) into gauges named
+``jepsen_tpu_<section>_<path>``, plus trace-derived latency
+histograms per span kind when the flight recorder is enabled. The
+daemon serves this at ``GET /metrics`` (text/plain; version=0.0.4),
+so a stock Prometheus scrape config needs nothing but the port.
+
+Stdlib-only; the jax-backed snapshot module is imported lazily inside
+``prometheus_text`` so importing this module costs nothing.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+#: histogram bucket upper bounds, in seconds — spans range from µs
+#: bitset probes to multi-second collect trains behind the ~94 ms
+#: sync floor, so a decade ladder covers the dynamic range
+BUCKETS_S = (0.001, 0.01, 0.1, 1.0, 10.0)
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _sanitize(part: str) -> str:
+    return _NAME_OK.sub("_", str(part))
+
+
+def _flatten(prefix: str, obj: dict, out: List[Tuple[str, float]]) -> None:
+    for k in sorted(obj):
+        v = obj[k]
+        name = f"{prefix}_{_sanitize(k)}"
+        if isinstance(v, bool):
+            out.append((name, 1.0 if v else 0.0))
+        elif isinstance(v, (int, float)):
+            out.append((name, float(v)))
+        elif isinstance(v, dict):
+            _flatten(name, v, out)
+        elif isinstance(v, (list, tuple)):
+            # lists (e.g. quarantined device labels) expose their size;
+            # the labels themselves belong in the JSON surfaces
+            out.append((name, float(len(v))))
+        # strings and None carry no gauge value
+
+
+def _histograms(events: List[dict]) -> Dict[str, Tuple[List[int], float, int]]:
+    """Per-kind duration histograms from complete events: kind ->
+    (cumulative bucket counts, sum_seconds, count)."""
+    hists: Dict[str, Tuple[List[int], float, int]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        dur_s = e.get("dur", 0) / 1e9
+        kind = _sanitize(e.get("kind", "span"))
+        if kind not in hists:
+            hists[kind] = ([0] * (len(BUCKETS_S) + 1), 0.0, 0)
+        counts, total, n = hists[kind]
+        for i, le in enumerate(BUCKETS_S):
+            if dur_s <= le:
+                counts[i] += 1
+        counts[-1] += 1  # +Inf
+        hists[kind] = (counts, total + dur_s, n + 1)
+    return hists
+
+
+def prometheus_text(snapshot: Optional[dict] = None,
+                    events: Optional[List[dict]] = None) -> str:
+    """Render the full exposition. Pass ``snapshot``/``events`` to
+    render a captured state (tests, trace-summary); default reads the
+    live engine."""
+    if snapshot is None:
+        from jepsen_tpu.obs.snapshot import engine_snapshot
+
+        snapshot = engine_snapshot()
+    if events is None:
+        from jepsen_tpu.obs import trace as _trace
+
+        events = _trace.spans() if _trace.TRACER.enabled else []
+
+    lines: List[str] = []
+    gauges: List[Tuple[str, float]] = []
+    for section in sorted(snapshot):
+        sec = snapshot[section]
+        if isinstance(sec, dict):
+            _flatten(f"jepsen_tpu_{_sanitize(section)}", sec, gauges)
+        elif isinstance(sec, (bool, int, float)):
+            gauges.append((f"jepsen_tpu_{_sanitize(section)}", float(sec)))
+    for name, value in gauges:
+        lines.append(f"# HELP {name} Engine counter {name}.")
+        lines.append(f"# TYPE {name} gauge")
+        # %g keeps integers integral and floats short
+        lines.append(f"{name} {value:g}")
+
+    hname = "jepsen_tpu_span_duration_seconds"
+    hists = _histograms(events)
+    if hists:
+        lines.append(f"# HELP {hname} Flight-recorder span durations "
+                     "by span kind.")
+        lines.append(f"# TYPE {hname} histogram")
+        for kind in sorted(hists):
+            counts, total, n = hists[kind]
+            for le, c in zip(BUCKETS_S, counts):
+                lines.append(
+                    f'{hname}_bucket{{kind="{kind}",le="{le:g}"}} {c}')
+            lines.append(
+                f'{hname}_bucket{{kind="{kind}",le="+Inf"}} {counts[-1]}')
+            lines.append(f'{hname}_sum{{kind="{kind}"}} {total:g}')
+            lines.append(f'{hname}_count{{kind="{kind}"}} {n}')
+    return "\n".join(lines) + "\n"

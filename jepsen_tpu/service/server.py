@@ -66,6 +66,7 @@ from typing import Any, Optional
 
 from jepsen_tpu.checker import chaos, dispatch
 from jepsen_tpu.history.history import History
+from jepsen_tpu.obs import trace as obs_trace
 from jepsen_tpu.history.sentry import HistorySentryError, validate_history
 from jepsen_tpu.service.admission import (
     DEFAULT_MAX_INFLIGHT,
@@ -244,8 +245,13 @@ class CheckerDaemon:
     # -- the check pipeline (called from handler threads) --------------
 
     def stats(self) -> dict:
+        from jepsen_tpu.obs.snapshot import engine_snapshot
+
+        # the consolidated engine snapshot (dispatch/launch/mesh/
+        # resilience/checkpoint/streaming/txn_graph/trace) plus the
+        # service-only surfaces layered on top
         return {
-            "dispatch": dispatch.dispatch_stats(),
+            **engine_snapshot(),
             "tenants": self.ledger.snapshot(),
             "admission": self.admission.snapshot(),
             "uptime_s": time.time() - self.started_at,
@@ -349,10 +355,13 @@ class CheckerDaemon:
                 return resolver()
 
         try:
-            if deadline_s is not None:
-                out = chaos.run_with_deadline(run, float(deadline_s))
-            else:
-                out = run()
+            with obs_trace.span("check", kind="service", tenant=tenant,
+                                model=model, durable=durable,
+                                deadline_s=deadline_s):
+                if deadline_s is not None:
+                    out = chaos.run_with_deadline(run, float(deadline_s))
+                else:
+                    out = run()
         except chaos.DeadlineExceeded:
             self.ledger.note(tenant, "deadline_timeouts")
             return 504, {
@@ -483,6 +492,18 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/stats":
             self._send_json(200, _jsonable(d.stats()))
             return
+        if self.path == "/metrics":
+            from jepsen_tpu.obs.prom import prometheus_text
+
+            body = prometheus_text().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         self._send_json(404, {"error": "not-found"})
 
     def do_POST(self):  # noqa: N802 (stdlib API)
@@ -492,27 +513,34 @@ class _Handler(BaseHTTPRequestHandler):
         d = self.daemon_obj
         tenant = self._tenant()
         cl = self.headers.get("Content-Length")
-        try:
-            d.admission.check_payload(
-                tenant, int(cl) if cl is not None else None
-            )
-            token = d.admission.admit(tenant)
-        except AdmissionError as e:
-            self._send_json(e.status, {
-                "error": e.reason, "detail": e.detail,
-            })
-            return
-        try:
-            body = self.rfile.read(int(cl))
-            if self.path == "/check/stream":
-                status, obj = d.handle_stream(tenant, body)
-            else:
-                status, obj = d.handle_check(tenant, body)
-        except Exception as e:  # noqa: BLE001 - last-resort envelope
-            log.exception("unhandled service error")
-            status, obj = 500, {
-                "error": "internal", "detail": str(e),
-            }
-        finally:
-            token.release()
-        self._send_json(status, obj)
+        # per-request root span: tenant + path up front, admission
+        # verdict and response status attached as they're decided
+        with obs_trace.span("request", kind="service", tenant=tenant,
+                            path=self.path) as sp:
+            try:
+                d.admission.check_payload(
+                    tenant, int(cl) if cl is not None else None
+                )
+                token = d.admission.admit(tenant)
+            except AdmissionError as e:
+                sp.set(admission=e.reason, status=e.status)
+                self._send_json(e.status, {
+                    "error": e.reason, "detail": e.detail,
+                })
+                return
+            sp.set(admission="admitted")
+            try:
+                body = self.rfile.read(int(cl))
+                if self.path == "/check/stream":
+                    status, obj = d.handle_stream(tenant, body)
+                else:
+                    status, obj = d.handle_check(tenant, body)
+            except Exception as e:  # noqa: BLE001 - last-resort envelope
+                log.exception("unhandled service error")
+                status, obj = 500, {
+                    "error": "internal", "detail": str(e),
+                }
+            finally:
+                token.release()
+            sp.set(status=status)
+            self._send_json(status, obj)

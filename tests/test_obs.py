@@ -1,0 +1,313 @@
+"""Flight-recorder tests (jepsen_tpu/obs): span/instant semantics,
+the disabled-mode free-ness guarantee, ring bounding, the launch-
+accounting parity pin (trace instants == LAUNCH_STATS on a mesh run),
+Chrome-trace schema, Prometheus exposition, the consolidated engine
+snapshot, and the analyze --trace / trace-summary CLI surfaces."""
+
+import json
+import re
+import threading
+
+import pytest
+
+from jepsen_tpu import obs
+from jepsen_tpu.obs import trace as obs_trace
+from jepsen_tpu.obs.export import chrome_trace, validate_chrome_trace
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _quiet_tracer():
+    """Every test starts and ends with the recorder off and empty —
+    the tracer is process-wide state, like the stats planes."""
+    obs.disable()
+    obs_trace.TRACER.clear()
+    yield
+    obs.disable()
+    obs_trace.TRACER.clear()
+
+
+# -- span / instant semantics -----------------------------------------
+
+
+def test_span_records_complete_event_with_set_attrs():
+    obs.enable()
+    with obs.span("check", kind="service", tenant="t0") as sp:
+        sp.set(status=200)
+    (ev,) = obs.spans()
+    assert ev["name"] == "check" and ev["kind"] == "service"
+    assert ev["ph"] == "X" and ev["dur"] >= 0
+    assert ev["args"] == {"tenant": "t0", "status": 200}
+    assert ev["tid"] == threading.get_ident()
+
+
+def test_nested_spans_and_instants_order_by_start():
+    obs.enable()
+    with obs.span("outer"):
+        obs.instant("mark", kind="launch_stat", n=1)
+        with obs.span("inner"):
+            pass
+    names = [e["name"] for e in obs.spans()]
+    # sorted by start ts: outer opened first, then the instant, then
+    # inner — completion order (inner closes first) must not leak in
+    assert names == ["outer", "mark", "inner"]
+    st = obs.trace_stats()
+    assert st["spans"] == 2 and st["instants"] == 1
+    assert st["by_kind"]["launch_stat"] == 1
+
+
+def test_disabled_mode_is_noop_singleton():
+    # one attribute check, one shared object, zero allocations
+    assert obs.span("a") is obs.span("b")
+    assert obs.span("a").__enter__().set(x=1).__exit__() is False
+    assert obs.instant("a", n=1) is None
+    assert obs_trace.TRACER._rings == {}
+    assert obs.trace_stats()["events"] == 0
+
+
+def test_disabled_mode_full_check_allocates_no_rings():
+    """The overhead guard's structural half: a full instrumented check
+    with the tracer off must never touch a ring (the bench pins the
+    < 1% wall half on hardware)."""
+    from jepsen_tpu.checker.events import history_to_events
+    from jepsen_tpu.checker.sharded import check_keys
+    from jepsen_tpu.sim import gen_register_history
+    import random
+
+    streams = [
+        history_to_events(gen_register_history(
+            random.Random(s), n_ops=16, n_procs=2))
+        for s in range(3)
+    ]
+    res = check_keys(streams, mesh=False)
+    assert len(res) == 3
+    assert obs_trace.TRACER._rings == {}
+    assert obs.trace_stats() == {
+        "enabled": False, "events": 0, "spans": 0, "instants": 0,
+        "dropped": 0, "by_kind": {},
+    }
+
+
+def test_ring_bounds_memory_and_counts_drops():
+    obs.enable(capacity=16)
+    for i in range(100):
+        obs.instant("tick", kind="soak", i=i)
+    st = obs.trace_stats()
+    assert st["events"] < 32  # never holds 2x capacity after a trim
+    assert st["dropped"] > 0
+    assert st["events"] + st["dropped"] == 100
+    # the survivors are the newest events (owner-side front trim)
+    assert obs.spans()[-1]["args"]["i"] == 99
+    obs_trace.TRACER.capacity = obs_trace.DEFAULT_CAPACITY
+
+
+def test_per_thread_rings_stamp_tid_and_tname():
+    obs.enable()
+
+    def emit():
+        obs.instant("from_worker", kind="test")
+
+    t = threading.Thread(target=emit, name="worker-0")
+    t.start()
+    t.join()
+    obs.instant("from_main", kind="test")
+    by_name = {e["name"]: e for e in obs.spans()}
+    assert by_name["from_worker"]["tname"] == "worker-0"
+    assert by_name["from_worker"]["tid"] != by_name["from_main"]["tid"]
+
+
+# -- launch-accounting parity (the differential pin) ------------------
+
+
+@pytest.mark.mesh
+def test_trace_instants_equal_launch_stats_on_mesh_run():
+    """THE parity pin: every _bump_launch mirrors one launch_stat
+    instant, so summing instants per name from the trace reproduces
+    LAUNCH_STATS exactly — the timeline and the counters are two views
+    of the same accounting, never two accountings."""
+    import random
+
+    from jepsen_tpu.checker.events import history_to_events
+    from jepsen_tpu.checker.sharded import check_keys
+    from jepsen_tpu.checker.wgl_bitset import launch_stats_snapshot
+    from jepsen_tpu.obs.snapshot import reset_engine_stats
+    from jepsen_tpu.sim import corrupt_history, gen_register_history
+
+    streams = []
+    for seed in range(6):
+        rng = random.Random(seed)
+        h = gen_register_history(rng, n_ops=20, n_procs=3)
+        if seed % 2:
+            h = corrupt_history(h, rng)
+        streams.append(history_to_events(h))
+    # warm the jit caches untraced so compile-time launches don't
+    # differ between the two views' observation windows
+    check_keys(streams, interpret=True)
+    reset_engine_stats()
+    obs.enable()
+    check_keys(streams, interpret=True)
+    obs.disable()
+    ls = launch_stats_snapshot()
+    counted = {}
+    for e in obs.spans():
+        if e["kind"] == "launch_stat":
+            counted[e["name"]] = (
+                counted.get(e["name"], 0) + e["args"]["n"]
+            )
+    assert ls["launches"] > 0 and ls["host_syncs"] > 0
+    for key, val in ls.items():
+        assert counted.get(key, 0) == val, (key, counted, ls)
+
+
+# -- export schema ----------------------------------------------------
+
+
+def test_chrome_trace_schema_golden(tmp_path):
+    obs.enable()
+    with obs.span("launch", kind="launch"):
+        obs.instant("launches", kind="launch_stat", n=1)
+    events = obs.spans()
+    obj = chrome_trace(events)
+    assert validate_chrome_trace(obj) == []
+    # structure Perfetto's legacy importer needs, pinned exactly
+    metas = [e for e in obj["traceEvents"] if e["ph"] == "M"]
+    xs = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+    inst = [e for e in obj["traceEvents"] if e["ph"] == "i"]
+    assert len(metas) == 1 and metas[0]["name"] == "thread_name"
+    assert len(xs) == 1 and xs[0]["cat"] == "launch"
+    assert inst[0]["s"] == "t"
+    # ts rebased to the earliest event and lowered ns -> us
+    assert min(e["ts"] for e in xs + inst) == 0.0
+    # survives a disk roundtrip
+    p = tmp_path / "t.json"
+    obs.write_chrome_trace(str(p), events)
+    assert validate_chrome_trace(json.loads(p.read_text())) == []
+
+
+def test_chrome_trace_validator_rejects_torn_events():
+    bad = {"traceEvents": [
+        {"name": "x", "ph": "X", "pid": 1, "tid": 1, "ts": 0},  # no dur
+        {"name": "y", "ph": "i", "pid": 1, "tid": 1, "ts": 0},  # no s
+        {"name": "", "ph": "Q", "pid": 1, "tid": 1, "ts": 0},   # bad ph
+    ]}
+    errors = validate_chrome_trace(bad)
+    assert len(errors) == 3
+    assert validate_chrome_trace({"events": []}) != []
+
+
+# -- the consolidated snapshot + Prometheus ---------------------------
+
+
+def test_engine_snapshot_is_the_one_reader():
+    from jepsen_tpu.obs.snapshot import engine_snapshot
+
+    snap = engine_snapshot()
+    assert set(snap) == {
+        "dispatch", "launch", "mesh", "resilience", "checkpoint",
+        "streaming", "txn_graph", "trace",
+    }
+    # sections carry their planes' own snapshot shapes
+    assert "launches" in snap["launch"]
+    assert "enabled" in snap["trace"]
+    assert isinstance(snap["txn_graph"], dict)
+
+
+def test_reset_engine_stats_resets_every_plane():
+    from jepsen_tpu.checker.wgl_bitset import (
+        _bump_launch,
+        launch_stats_snapshot,
+    )
+    from jepsen_tpu.obs.snapshot import reset_engine_stats
+
+    obs.enable()
+    _bump_launch("launches")
+    assert launch_stats_snapshot()["launches"] >= 1
+    assert obs.trace_stats()["events"] == 1
+    reset_engine_stats()
+    assert launch_stats_snapshot()["launches"] == 0
+    assert obs.trace_stats()["events"] == 0
+
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+    r"(-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|NaN)$"
+)
+
+
+def test_prometheus_exposition_format():
+    from jepsen_tpu.obs.prom import prometheus_text
+
+    obs.enable()
+    with obs.span("check", kind="service"):
+        pass
+    text = prometheus_text()
+    lines = [ln for ln in text.splitlines() if ln]
+    assert lines, "empty exposition"
+    for ln in lines:
+        if ln.startswith("#"):
+            assert ln.startswith(("# HELP ", "# TYPE "))
+        else:
+            assert _PROM_LINE.match(ln), ln
+    # the engine gauges and the trace-derived histogram both fold in
+    assert any(ln.startswith("jepsen_tpu_launch_launches ")
+               for ln in lines)
+    hist = [ln for ln in lines if "span_duration_seconds_bucket" in ln
+            and 'kind="service"' in ln]
+    assert hist and any('le="+Inf"' in ln for ln in hist)
+    counts = [float(ln.rsplit(" ", 1)[1]) for ln in hist]
+    assert counts == sorted(counts)  # cumulative buckets
+
+
+# -- CLI surfaces -----------------------------------------------------
+
+
+def test_cli_analyze_trace_and_summary(tmp_path, capsys, monkeypatch):
+    """analyze --trace writes a Perfetto-loadable trace whose
+    launch_stat instants equal the engine's LAUNCH_STATS, and
+    trace-summary renders the attribution table from it."""
+    from jepsen_tpu.checker.wgl_bitset import launch_stats_snapshot
+    from jepsen_tpu.cli import EXIT_VALID, main
+
+    # Pallas interpret mode: the seam that takes the device branch
+    # (and therefore pays counted launches/syncs) on a CPU-only host
+    monkeypatch.setenv("JEPSEN_TPU_INTERPRET", "1")
+    store_root = str(tmp_path / "store")
+    assert main([
+        "test", "--workload", "register", "--ops", "40",
+        "--store", store_root, "--name", "obs-run", "--seed", "7",
+    ]) in (0, 1)
+    trace_path = str(tmp_path / "trace.json")
+    code = main([
+        "analyze", "obs-run", "--workload", "register",
+        "--store", store_root, "--trace", trace_path,
+    ])
+    assert code in (0, 1)
+    obj = json.loads(open(trace_path).read())
+    assert validate_chrome_trace(obj) == []
+    # parity through the CLI surface: the trace's launch accounting
+    # is the engine's launch accounting
+    ls = launch_stats_snapshot()
+    counted = {}
+    for e in obj["traceEvents"]:
+        if e.get("cat") == "launch_stat":
+            counted[e["name"]] = (
+                counted.get(e["name"], 0) + e["args"]["n"]
+            )
+    assert counted.get("launches", 0) == ls["launches"] > 0
+    assert counted.get("host_syncs", 0) == ls["host_syncs"] > 0
+    # the wrapper printed the export line and disabled the tracer
+    assert not obs_trace.TRACER.enabled
+    capsys.readouterr()
+    assert main(["trace-summary", trace_path]) == EXIT_VALID
+    out = capsys.readouterr().out
+    assert "wall" in out and "launch_stat" in out
+
+
+def test_cli_trace_summary_rejects_bad_schema(tmp_path, capsys):
+    from jepsen_tpu.cli import EXIT_UNKNOWN, main
+
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"traceEvents": [{"ph": "Z"}]}))
+    assert main(["trace-summary", str(p)]) == EXIT_UNKNOWN
+    assert "schema" in capsys.readouterr().out

@@ -257,6 +257,83 @@ def snapshot():
         return dict(CORPUS_STATS)
 """,
     ),
+    "JT301": (
+        # span held in a variable — never (reliably) closed
+        """
+from jepsen_tpu.obs import trace as obs_trace
+
+def f(x):
+    s = obs_trace.span("collect", kind="collect")
+    s.__enter__()
+    return x
+""",
+        """
+from jepsen_tpu.obs import trace as obs_trace
+
+def f(x):
+    with obs_trace.span("collect", kind="collect"):
+        return x
+""",
+    ),
+    "JT302": (
+        # emission while the stats lock is held
+        """
+import threading
+
+from jepsen_tpu.obs import trace as obs_trace
+
+_corpus_lock = threading.Lock()
+
+def f():
+    with _corpus_lock:
+        obs_trace.instant("tick", kind="corpus")
+""",
+        """
+import threading
+
+from jepsen_tpu.obs import trace as obs_trace
+
+_corpus_lock = threading.Lock()
+
+def f():
+    with _corpus_lock:
+        pass
+    obs_trace.instant("tick", kind="corpus")
+""",
+    ),
+    "JT303": (
+        # emission inside a function that only runs under jax tracing
+        """
+import jax
+
+from jepsen_tpu.obs import trace as obs_trace
+
+def _impl(a):
+    obs_trace.instant("step", kind="corpus")
+    return a
+
+scan = jax.jit(_impl)
+
+def f(a):
+    _bump_launch("launches")
+    return scan(a)
+""",
+        """
+import jax
+
+from jepsen_tpu.obs import trace as obs_trace
+
+def _impl(a):
+    return a
+
+scan = jax.jit(_impl)
+
+def f(a):
+    _bump_launch("launches")
+    obs_trace.instant("step", kind="corpus")
+    return scan(a)
+""",
+    ),
 }
 
 

@@ -137,6 +137,44 @@ def test_roundtrip_verdict_parity(tmp_path):
         assert st["dispatch"]["requests"] >= 1
 
 
+def test_metrics_endpoint_serves_prometheus_text(tmp_path):
+    """GET /metrics: the engine snapshot as Prometheus text exposition
+    — every stats plane (streaming and txn-graph included, the
+    consolidation satellite) folds into jepsen_tpu_* gauges, and the
+    body parses line-by-line as the text format."""
+    import re
+    import urllib.request
+
+    good = _register(103)
+    with running_daemon(tmp_path) as d:
+        c = _client(d, tenant="bob")
+        c.check(good, model="cas-register")
+        # /stats serves the consolidated engine snapshot sections
+        st = c.stats()
+        for section in ("dispatch", "launch", "streaming", "txn_graph",
+                        "trace", "resilience", "checkpoint"):
+            assert section in st, section
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{d.port}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            body = r.read().decode()
+    line = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+        r"(-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|NaN)$"
+    )
+    names = set()
+    for ln in body.splitlines():
+        if not ln or ln.startswith(("# HELP ", "# TYPE ")):
+            continue
+        assert line.match(ln), ln
+        names.add(ln.split("{")[0].split(" ")[0])
+    assert "jepsen_tpu_launch_launches" in names
+    assert "jepsen_tpu_dispatch_requests" in names
+    assert any(n.startswith("jepsen_tpu_streaming_") for n in names)
+    assert any(n.startswith("jepsen_tpu_txn_graph_") for n in names)
+
+
 @pytest.mark.slow
 def test_roundtrip_invalid_verdict_parity(tmp_path):
     from jepsen_tpu.sim import corrupt_history

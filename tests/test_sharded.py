@@ -98,6 +98,10 @@ def test_graft_entry_contract(capfd):
     # findings (hot-path residency + lock discipline hold at review
     # time, not just at runtime).
     assert rec["lint_findings"] == 0
+    # Flight-recorder liveness rides the same line: the dryrun runs
+    # traced, so the metric that claims the floor was paid once comes
+    # with the timeline that shows where.
+    assert int(rec["trace_spans"]) > 0
 
 
 def test_sharded_at_scale_with_escalation_keys():
