@@ -1267,6 +1267,169 @@ def _device_health_gate(
     raise SystemExit(3)
 
 
+#: the per-backend matrix child: one tiny fixed workload pair, timed
+#: after a warm pass, one JSON row on stdout. Runs pinned to a single
+#: backend in a fresh subprocess (bench's own process must never flip
+#: platforms mid-run).
+_MATRIX_CHILD = r"""
+import json, math, random, time
+import jax
+from jepsen_tpu.checker.events import history_to_events
+from jepsen_tpu.checker.sharded import check_keys
+from jepsen_tpu.sim import gen_register_history
+
+def _streams(n_keys, n_ops, base):
+    out = []
+    for s in range(n_keys):
+        h = gen_register_history(
+            random.Random(base + s), n_ops=n_ops, n_procs=3,
+            p_crash=0.02,
+        )
+        out.append(history_to_events(h))
+    return out
+
+def _timed(fn):
+    t0 = time.perf_counter(); fn()
+    return time.perf_counter() - t0
+
+work = {
+    "keys16x200": _streams(16, 200, 0),
+    "solo1x1000": _streams(1, 1000, 900),
+}
+walls = {}
+for name, st in sorted(work.items()):
+    check_keys(st)  # warm: compile + memoize packing
+    walls[name] = round(
+        min(_timed(lambda: check_keys(st)) for _ in range(2)), 4
+    )
+geo = math.exp(
+    sum(math.log(max(w, 1e-9)) for w in walls.values()) / len(walls)
+)
+if int(jax.process_index()) == 0:
+    print(json.dumps({
+        "backend": str(jax.default_backend()),
+        "n_devices": len(jax.devices()),
+        "n_hosts": int(jax.process_count()),
+        "resolved_walls_s": walls,
+        "geomean_wall_s": round(geo, 4),
+    }), flush=True)
+"""
+
+
+def _probe_backends() -> list:
+    """Which JAX platforms this environment can actually initialize —
+    probed in throwaway subprocesses so a missing plugin can't poison
+    the bench process."""
+    import os
+    import subprocess
+
+    found = []
+    for b in ("cpu", "gpu", "tpu"):
+        env = dict(os.environ, JAX_PLATFORMS=b)
+        env.pop("XLA_FLAGS", None)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(len(jax.devices()))"],
+                env=env, capture_output=True, text=True, timeout=120,
+            )
+        except subprocess.TimeoutExpired:
+            continue
+        if r.returncode == 0 and r.stdout.strip().isdigit() and (
+            int(r.stdout.strip()) > 0
+        ):
+            found.append(b)
+    return found
+
+
+def bench_backend_matrix(pod_hosts: int = 0) -> dict:
+    """The backend matrix: the SAME code path (check_keys over the
+    ambient mesh) timed per available backend, each in a pinned
+    subprocess, plus — when ``--pod N`` asked for one — a row from a
+    real N-process localhost CPU pod. A requested pod that silently
+    comes up single-host is FATAL (exit 6), mirroring the exit-4
+    one-device mesh guard: a single-host wall must never publish as a
+    pod wall."""
+    import os
+    import subprocess
+
+    rows = []
+    for b in _probe_backends():
+        env = dict(os.environ, JAX_PLATFORMS=b)
+        if b == "cpu":
+            env["XLA_FLAGS"] = (
+                "--xla_force_host_platform_device_count=8"
+            )
+        else:
+            env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = (
+            os.path.dirname(os.path.abspath(__file__))
+            + os.pathsep + env.get("PYTHONPATH", "")
+        ).rstrip(os.pathsep)
+        r = subprocess.run(
+            [sys.executable, "-c", _MATRIX_CHILD],
+            env=env, capture_output=True, text=True, timeout=600,
+        )
+        lines = [x for x in r.stdout.strip().splitlines() if x]
+        if r.returncode != 0 or not lines:
+            print(
+                f"backend_matrix: {b} probe ran but the timed child "
+                f"failed (rc={r.returncode}):\n{r.stderr[-1000:]}",
+                file=sys.stderr,
+            )
+            continue
+        rows.append(json.loads(lines[-1]))
+    pod_row = None
+    if pod_hosts >= 2:
+        from jepsen_tpu.pod.launcher import launch_pod
+
+        procs = launch_pod(
+            pod_hosts, _MATRIX_CHILD, n_local_devices=4,
+            timeout_s=600.0,
+        )
+        lines = [
+            x for x in procs[0].stdout.strip().splitlines() if x
+        ] if procs else []
+        if any(not p.ok for p in procs) or not lines:
+            for p in procs:
+                if not p.ok:
+                    print(
+                        f"pod member {p.process_id} "
+                        f"rc={p.returncode}\n{p.stderr[-1000:]}",
+                        file=sys.stderr,
+                    )
+            print(
+                f"FATAL: --pod {pod_hosts} requested but the pod row "
+                "produced no measurement",
+                file=sys.stderr,
+            )
+            raise SystemExit(6)
+        pod_row = json.loads(lines[-1])
+        if int(pod_row.get("n_hosts", 1)) != pod_hosts:
+            print(
+                f"FATAL: --pod {pod_hosts} requested but the pod ran "
+                f"on {pod_row.get('n_hosts', 1)} host(s) — a "
+                "single-host wall must never publish as a pod wall",
+                file=sys.stderr,
+            )
+            raise SystemExit(6)
+        pod_row["pod"] = True
+        rows.append(pod_row)
+    for row in rows:
+        print(
+            "backend_matrix: backend={backend} n_devices={nd} "
+            "n_hosts={nh} geomean_wall={gw}s".format(
+                backend=row["backend"], nd=row["n_devices"],
+                nh=row["n_hosts"], gw=row["geomean_wall_s"],
+            ),
+            file=sys.stderr,
+        )
+    return {
+        "backends": rows,
+        "requested_pod_hosts": pod_hosts or None,
+    }
+
+
 def main() -> None:
     global SMOKE
 
@@ -1331,6 +1494,29 @@ def main() -> None:
     if _pin:
         jax.config.update("jax_platforms", _pin)
 
+    # Explicit mesh seam (same flags as cli analyze/daemon): pin the
+    # policy before any plane resolves a mesh.
+    def _argval(flag):
+        if flag not in sys.argv:
+            return None
+        try:
+            return sys.argv[sys.argv.index(flag) + 1]
+        except IndexError:
+            raise SystemExit(f"usage: {flag} VALUE")
+
+    _dev = _argval("--devices")
+    _backend = _argval("--backend")
+    if _dev is not None or _backend is not None:
+        from jepsen_tpu.checker import sharded as _sharded
+
+        try:
+            _sharded.set_mesh_policy(
+                devices=int(_dev) if _dev is not None else None,
+                backend=_backend,
+            )
+        except ValueError:
+            raise SystemExit("usage: --devices N (an integer)")
+
     if chaos_mode:
         bench_chaos_smoke()
         return
@@ -1374,6 +1560,21 @@ def main() -> None:
             file=sys.stderr,
         )
         raise SystemExit(4)
+
+    # Backend matrix: per-backend resolved-wall geomeans (and the
+    # --pod N row) ride the published JSON. Runs after the mesh guard
+    # so a broken scale-out path never gets as far as publishing a
+    # matrix.
+    pod_hosts = 0
+    if "--pod" in sys.argv:
+        try:
+            pod_hosts = int(sys.argv[sys.argv.index("--pod") + 1])
+        except (IndexError, ValueError):
+            raise SystemExit("usage: --pod N (N >= 2 pod processes)")
+    backend_matrix = (
+        None if "--no-backend-matrix" in sys.argv
+        else bench_backend_matrix(pod_hosts)
+    )
 
     # Resolution accounting (BENCH_r05 etcd-1k): when the native racer
     # beats the floor-bound device wall on a race-eligible config, the
@@ -1529,6 +1730,12 @@ def main() -> None:
                         else None
                     ),
                 },
+                # backend_matrix: the same check_keys path timed per
+                # available backend (pinned subprocesses), plus the
+                # --pod N multi-process row when requested (exit 6 on
+                # silent single-host fallback). None with
+                # --no-backend-matrix.
+                "backend_matrix": backend_matrix,
                 "sync_floor_ms": round(rt * 1e3, 1),
                 # Per-config record (VERDICT r4 Weak #7): solo wall,
                 # strongest-CPU baseline, and the floor-subtracted

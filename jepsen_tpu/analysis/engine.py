@@ -56,6 +56,8 @@ FAMILY_B_FILES = (
     "checker/checkpoint.py",
     "runtime/core.py",
     "service/*.py",
+    "pod/topology.py",
+    "pod/faultdomains.py",
     "cli.py",
 )
 
@@ -65,6 +67,7 @@ FAMILY_C_FILES = (
     "checker/*.py",
     "service/*.py",
     "obs/*.py",
+    "pod/*.py",
     "cli.py",
 )
 

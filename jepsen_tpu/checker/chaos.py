@@ -458,6 +458,29 @@ def quarantined_tenants() -> tuple:
         )
 
 
+#: host pseudo-labels: "host:<process_index>" rows mark a whole
+#: failure DOMAIN as dead (pod.faultdomains maps them to device
+#: slices). Unlike tenant labels they DO shrink meshes — mesh_without
+#: expands them into the domain's device labels — but like tenant
+#: labels no single device is ever named "host:...", so the plain
+#: per-chip matching paths ignore them.
+HOST_PREFIX = "host:"
+
+
+def is_host_label(label: str) -> bool:
+    return isinstance(label, str) and label.startswith(HOST_PREFIX)
+
+
+def quarantined_hosts() -> tuple:
+    """Host ids (prefix stripped) currently quarantined — whole-slice
+    ejections from the pod's failure-domain ladder."""
+    with _stats_lock:
+        return tuple(
+            q[len(HOST_PREFIX):] for q in _QUARANTINED
+            if is_host_label(q)
+        )
+
+
 def note_degradation(n: int = 1) -> None:
     with _stats_lock:
         RESILIENCE_STATS["degradations"] += n
@@ -503,6 +526,21 @@ def clear_quarantine_hooks() -> None:
         _QUARANTINE_HOOKS.clear()
 
 
+def _post_quarantine(label: str) -> None:
+    """The after-trip tail shared by every quarantine entry point:
+    trace instant + observer hooks, invoked with NO lock held
+    (planelint JT204) — a hook that re-enters the stats API must not
+    find _stats_lock held, and a slow hook never stalls accounting."""
+    obs_trace.instant("quarantine", kind="chaos", device=label)
+    with _hooks_lock:
+        hooks = tuple(_QUARANTINE_HOOKS)
+    for fn in hooks:
+        try:
+            fn(label)
+        except Exception:  # noqa: BLE001 - observer must not
+            pass  # break the accounting path it observes
+
+
 def note_device_failure(label: str, quarantine_after: int = 3) -> bool:
     """Count one attributed failure against a device; returns True the
     moment the count crosses ``quarantine_after`` and the device is
@@ -514,23 +552,41 @@ def note_device_failure(label: str, quarantine_after: int = 3) -> bool:
         if tripped:
             _QUARANTINED.append(label)
     if tripped:
-        obs_trace.instant("quarantine", kind="chaos", device=label)
-        # snapshot the hook list under its lock, then invoke AFTER
-        # every lock is released (planelint JT204) — a hook that
-        # re-enters the stats API must not find _stats_lock held
-        with _hooks_lock:
-            hooks = tuple(_QUARANTINE_HOOKS)
-        for fn in hooks:
-            try:
-                fn(label)
-            except Exception:  # noqa: BLE001 - observer must not
-                pass  # break the accounting path it observes
+        _post_quarantine(label)
+    return tripped
+
+
+def quarantine_label(label: str) -> bool:
+    """Eject a label IMMEDIATELY, skipping the failure-count ladder —
+    the failure-domain path: one dead process condemns its whole slice
+    without waiting for per-device evidence the dead chips can no
+    longer produce. Fires the same trace instant and hooks as a
+    threshold trip; idempotent (returns False when already out)."""
+    with _stats_lock:
+        tripped = label not in _QUARANTINED
+        if tripped:
+            _QUARANTINED.append(label)
+    if tripped:
+        _post_quarantine(label)
     return tripped
 
 
 def quarantined_devices() -> tuple:
-    """Real quarantined device labels (tenant pseudo-labels excluded —
-    mesh builders and reshard ladders only ever eject chips)."""
+    """Real quarantined device labels (tenant and host pseudo-labels
+    excluded — per-chip matching paths only ever name chips; host rows
+    surface via quarantined_hosts / mesh_ejection_labels)."""
+    with _stats_lock:
+        return tuple(
+            q for q in _QUARANTINED
+            if not is_tenant_label(q) and not is_host_label(q)
+        )
+
+
+def mesh_ejection_labels() -> tuple:
+    """Every label that should shrink a mesh: quarantined devices PLUS
+    quarantined host rows (sharded.mesh_without expands the latter
+    into their domain's device slice). Tenant labels stay excluded —
+    a tenant breaker never touches topology."""
     with _stats_lock:
         return tuple(
             q for q in _QUARANTINED if not is_tenant_label(q)
@@ -554,11 +610,16 @@ def resilience_snapshot() -> dict:
     with _stats_lock:
         out = dict(RESILIENCE_STATS)
         out["quarantined_devices"] = [
-            q for q in _QUARANTINED if not is_tenant_label(q)
+            q for q in _QUARANTINED
+            if not is_tenant_label(q) and not is_host_label(q)
         ]
         out["quarantined_tenants"] = [
             q[len(TENANT_PREFIX):] for q in _QUARANTINED
             if is_tenant_label(q)
+        ]
+        out["quarantined_hosts"] = [
+            q[len(HOST_PREFIX):] for q in _QUARANTINED
+            if is_host_label(q)
         ]
         out["device_failures"] = dict(_DEVICE_FAILURES)
     return out

@@ -444,6 +444,7 @@ class DispatchPlane:
         quarantine_after: int = 3,
         worker_join_s: float = 10.0,
         max_inflight_trains: int = 2,
+        host_domain_quarantine: bool = True,
     ):
         from jepsen_tpu.checker.sharded import resolve_mesh
 
@@ -461,6 +462,10 @@ class DispatchPlane:
         self.launch_deadline_s = launch_deadline_s
         self.quarantine_after = quarantine_after
         self.worker_join_s = worker_join_s
+        #: host-level failure domains (pod.faultdomains): a quarantined
+        #: chip on a mesh spanning >1 host slice ejects its whole
+        #: domain. Off = per-chip quarantine only.
+        self.host_domain_quarantine = host_domain_quarantine
         self.mesh = resolve_mesh(mesh)
         #: optional per-future fault attribution hook for multi-tenant
         #: embedders (the service daemon's tenant ledger): called as
@@ -963,13 +968,35 @@ class DispatchPlane:
                 "(%s: %s); launches re-shard onto the survivors",
                 device, self.quarantine_after, type(exc).__name__, exc,
             )
+            if self.host_domain_quarantine:
+                # Host-level failure domain: on a mesh spanning >1
+                # host slice, a dead chip condemns its WHOLE domain
+                # (from across DCN a dead chip and a dead host are
+                # indistinguishable, and a half-dead slice wedges pod
+                # collectives). The ladder then ejects the slice in
+                # one reshard instead of bleeding through it chip by
+                # chip.
+                from jepsen_tpu.pod import faultdomains
+
+                h = faultdomains.escalate_device_to_host(
+                    device, self.mesh
+                )
+                if h is not None:
+                    logging.getLogger("jepsen_tpu.checker").warning(
+                        "host domain %s quarantined with %s; its "
+                        "whole slice ejects at the next reshard",
+                        h, device,
+                    )
 
     def _after_fault(self, mesh):
         """One degradation-ladder step after a guarded dispatch spent
         its retry budget: (1) a quarantine ejection re-shards the mesh
         onto the survivors (the blank-row pad absorbs the new uneven
-        split); (2) no survivors worth sharding — or no quarantine
-        evidence at all — drops to the single-device dispatch; (3) a
+        split; ``host:<i>`` ledger rows eject whole slices); (2) a
+        multi-host mesh that failed WITHOUT ejection evidence retreats
+        to this process's local host mesh (cross-host collectives no
+        longer trusted, local chips still good); (3) no survivors
+        worth sharding drops to the single-device dispatch; (4) a
         single-device failure exhausts the device rungs (the caller
         falls back to the host oracle). Returns (next_mesh, exhausted).
         Quarantine-driven shrinks of the PLANE's own mesh are sticky —
@@ -978,14 +1005,23 @@ class DispatchPlane:
             chaos.note_degradation()
             return None, True
         from jepsen_tpu.checker.sharded import mesh_without, note_reshard
+        from jepsen_tpu.pod import faultdomains
 
-        healthy = mesh_without(mesh, chaos.quarantined_devices())
+        healthy = mesh_without(mesh, chaos.mesh_ejection_labels())
         if healthy is not mesh and healthy is not None:
             note_reshard()
             if mesh is self.mesh:
                 self.mesh = healthy
                 self._devices = list(healthy.devices.flat)
             return healthy, False
+        if healthy is mesh and len(faultdomains.host_domains(mesh)) > 1:
+            local = faultdomains.local_host_mesh()
+            if local is not None and local is not mesh:
+                chaos.note_degradation()
+                if mesh is self.mesh:
+                    self.mesh = local
+                    self._devices = list(local.devices.flat)
+                return local, False
         chaos.note_degradation()
         if healthy is None and mesh is self.mesh:
             # quarantine left <2 survivors: the plane goes single-device

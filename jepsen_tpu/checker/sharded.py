@@ -170,7 +170,12 @@ def reset_mesh_stats() -> None:
 
 def mesh_stats_snapshot() -> dict:
     """Locked copy of MESH_STATS (the resilience block holds a mutable
-    list, so a shallow copy would alias it)."""
+    list, so a shallow copy would alias it), plus the pod topology
+    block (hosts / local vs. global devices / backend) — fetched
+    OUTSIDE the lock, since it may query live jax state."""
+    from jepsen_tpu.pod.topology import topology_snapshot
+
+    topo = topology_snapshot()
     with _mesh_stats_lock:
         res = MESH_STATS["resilience"]
         return {
@@ -180,6 +185,7 @@ def mesh_stats_snapshot() -> dict:
                 "quarantined_devices": list(res["quarantined_devices"]),
                 "resharded_launches": res["resharded_launches"],
             },
+            "topology": topo,
         }
 
 
@@ -194,20 +200,88 @@ def _mesh_over(devices: tuple) -> Mesh:
     return Mesh(np.asarray(devices), axis_names=("keys",))
 
 
-def default_mesh() -> Optional[Mesh]:
-    """The ambient execution mesh: a 1-D Mesh over every visible
-    HEALTHY device when more than one is visible, else None. check_keys
-    and the dispatch plane consult this when the caller passes
-    mesh=None, so multi-chip hosts (and the tests' virtual 8-device CPU
-    mesh) go sharded by default while a single-device host keeps the
-    exact byte-identical single-device dispatch. Devices ejected by the
-    resilience layer's quarantine (checker.chaos) are excluded — a
-    fresh auto-mesh re-shards onto the survivors."""
-    from jepsen_tpu.checker.chaos import is_quarantined
+@functools.lru_cache(maxsize=None)
+def _pod_mesh_over(rows: tuple) -> Mesh:
+    """The global hosts x chips mesh: one row per host (process), one
+    column per chip of that host — the DCN x ICI layout sharded
+    checking has carried as a virtual axis pair since PR 3, now backed
+    by real process boundaries."""
+    arr = np.asarray([list(r) for r in rows], dtype=object)
+    return Mesh(arr, axis_names=("hosts", "chips"))
 
-    devs = [d for d in jax.devices() if not is_quarantined(str(d))]
+
+#: the CLI's mesh-policy seam (set_mesh_policy): an explicit device
+#: cap and/or backend for the ambient mesh, so mesh shape is reachable
+#: from `analyze`/`daemon`/bench flags — not only the conftest
+#: JEPSEN_TPU_HOST_DEVICES env seam.
+_MESH_POLICY = {"devices": None, "backend": None}
+
+
+def set_mesh_policy(devices: Optional[int] = None,
+                    backend: Optional[str] = None) -> None:
+    """Pin the ambient mesh selection: ``devices`` caps the auto mesh
+    at N devices (1 forces the single-device path), ``backend``
+    selects which platform's devices it spans (cpu/gpu/tpu). None
+    clears the respective pin. Mesh builders are cached by device
+    tuple, so changing policy mid-process is safe."""
+    _MESH_POLICY["devices"] = int(devices) if devices else None
+    _MESH_POLICY["backend"] = backend or None
+
+
+def mesh_policy() -> dict:
+    return dict(_MESH_POLICY)
+
+
+def _healthy_devices() -> list:
+    """Visible devices minus quarantine ejections — per-chip labels
+    AND host-domain rows (a device whose owning process is quarantined
+    is dead even if its own label never accumulated evidence) — under
+    the CLI mesh policy's backend/device-count pins."""
+    from jepsen_tpu.checker.chaos import HOST_PREFIX, is_quarantined
+
+    backend = _MESH_POLICY["backend"]
+    base = jax.devices(backend) if backend else jax.devices()
+    devs = [
+        d for d in base
+        if not is_quarantined(str(d))
+        and not is_quarantined(
+            f"{HOST_PREFIX}{getattr(d, 'process_index', 0)}"
+        )
+    ]
+    cap = _MESH_POLICY["devices"]
+    if cap:
+        devs = devs[:cap]
+    return devs
+
+
+def default_mesh() -> Optional[Mesh]:
+    """The ambient execution mesh: a Mesh over every visible HEALTHY
+    device when more than one is visible, else None. check_keys and
+    the dispatch plane consult this when the caller passes mesh=None,
+    so multi-chip hosts (and the tests' virtual 8-device CPU mesh) go
+    sharded by default while a single-device host keeps the exact
+    byte-identical single-device dispatch. Devices ejected by the
+    resilience layer's quarantine (checker.chaos) are excluded — a
+    fresh auto-mesh re-shards onto the survivors.
+
+    In a pod (jax.process_count() > 1) the mesh generalizes to the
+    global hosts x chips layout: one "hosts" row per process, chips
+    within. Quarantine can leave hosts ragged (different survivor
+    counts per row); the mesh then falls back to 1-D over the global
+    survivors — keys shard over the full product either way
+    (key_spec), so verdicts are layout-independent."""
+    devs = _healthy_devices()
     if len(devs) < 2:
         return None
+    by_host: dict = {}
+    for d in devs:
+        by_host.setdefault(
+            int(getattr(d, "process_index", 0)), []
+        ).append(d)
+    if len(by_host) > 1:
+        rows = [tuple(by_host[h]) for h in sorted(by_host)]
+        if len({len(r) for r in rows}) == 1:
+            return _pod_mesh_over(tuple(rows))
     return _mesh_over(tuple(devs))
 
 
@@ -216,13 +290,17 @@ def mesh_without(mesh: Optional[Mesh], labels) -> Optional[Mesh]:
     quarantine ejection path): survivors rebuild as a 1-D mesh — the
     batch pad (launch_keys_bitset's blank rows / stack_streams'
     padding rows) absorbs the new uneven key split exactly like any
-    other non-multiple batch. Fewer than 2 survivors collapses to None
-    (the single-device path). A mesh with nothing to eject passes
-    through unchanged (same object, so lru-cached wrappers still
-    hit)."""
+    other non-multiple batch. ``host:<i>`` labels eject that host's
+    WHOLE device slice (pod.faultdomains expands them against this
+    mesh — real process slices in a pod, rows of a "hosts" axis on a
+    virtual one). Fewer than 2 survivors collapses to None (the
+    single-device path). A mesh with nothing to eject passes through
+    unchanged (same object, so lru-cached wrappers still hit)."""
     if mesh is None:
         return None
-    dead = set(labels)
+    from jepsen_tpu.pod.faultdomains import expand_host_labels
+
+    dead = expand_host_labels(mesh, labels)
     devs = list(mesh.devices.flat)
     survivors = tuple(d for d in devs if str(d) not in dead)
     if len(survivors) == len(devs):
@@ -538,15 +616,23 @@ def check_keys(
     else:
         # Place inputs on the mesh explicitly: a bare jnp.asarray lands
         # on the default backend, which may not be the mesh's platform
-        # (e.g. a virtual CPU mesh under an ambient TPU plugin).
-        from jax.sharding import NamedSharding
+        # (e.g. a virtual CPU mesh under an ambient TPU plugin). In a
+        # pod each process materializes only its addressable shards.
+        from jepsen_tpu.pod.slicing import host_shard_put
 
         cols = stack_streams(streams, W=W, n_keys=n_keys, model=model)
-        sharding = NamedSharding(mesh, key_spec(mesh))
-        args = tuple(jax.device_put(np.asarray(c), sharding) for c in cols)
+        args = host_shard_put(cols, mesh)
         fn = make_sharded_checker(mesh, model, K, W)
         alive, overflow, died = fn(*args)
         note_sharded_launch(n_dev)
+        # pod collect: sharded verdicts are not fully addressable
+        # across processes — one replicating all-gather (no-op
+        # single-process) before the funnel.
+        from jepsen_tpu.pod.slicing import global_view
+
+        alive, overflow, died = global_view(
+            (alive, overflow, died), mesh
+        )
     # ONE host sync for the whole stacked batch (all keys, all chips):
     # the funnel counts it toward the residency metric.
     from jepsen_tpu.checker import wgl_bitset as bs

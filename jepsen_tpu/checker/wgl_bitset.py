@@ -1233,13 +1233,11 @@ def launch_keys_bitset(
 
         n_dev = mesh_size(mesh)
     if n_dev > 1:
-        from jax.sharding import NamedSharding
-
         from jepsen_tpu.checker.sharded import (
-            key_spec,
             make_sharded_bitset,
             note_sharded_launch,
         )
+        from jepsen_tpu.pod.slicing import host_shard_put
 
         pad = -n_real % n_dev
         if pad:
@@ -1255,10 +1253,11 @@ def launch_keys_bitset(
                 fr0_h,
                 np.repeat(init_frontier(0, S, W)[None], pad, axis=0),
             ])
-        sharding = NamedSharding(mesh, key_spec(mesh))
-        win_j = jax.device_put(win_h, sharding)
-        meta_j = jax.device_put(meta_h, sharding)
-        fr0 = jax.device_put(fr0_h, sharding)
+        # key-spec placement; in a pod each process materializes only
+        # its addressable host-local shards (pod.slicing).
+        win_j, meta_j, fr0 = host_shard_put(
+            (win_h, meta_h, fr0_h), mesh
+        )
         fn = make_sharded_bitset(mesh, name, S, W, interpret, exact)
         _bump_launch("launches")
         note_sharded_launch(n_dev)
@@ -1295,6 +1294,13 @@ def collect_keys_bitset(handle, out_host=None) -> List[Tuple[bool, bool, int]]:
     out, (
         win_j, meta_j, fr0, name, S, W, interpret, exact, mesh, n_real
     ) = handle
+    if out_host is None and mesh is not None:
+        # pod collect: the sharded verdict array is not fully
+        # addressable across processes — one replicating all-gather
+        # (no-op single-process) before the funnel.
+        from jepsen_tpu.pod.slicing import global_view
+
+        out = global_view((out,), mesh)[0]
     verdicts = _out_to_verdicts(
         np.asarray(_host_get(out) if out_host is None else out_host)
     )[:n_real]
@@ -1324,6 +1330,9 @@ def collect_keys_bitset(handle, out_host=None) -> List[Tuple[bool, bool, int]]:
             lambda: fn(win_j, meta_j, fr0), site="launch",
             devices=[str(d) for d in mesh.devices.flat],
         )
+        from jepsen_tpu.pod.slicing import global_view
+
+        out2 = global_view((out2,), mesh)[0]
     else:
         out2, _ = chaos.resilient_call(
             lambda: _bitset_scan(

@@ -156,6 +156,30 @@ def _reset_engine_state() -> None:
     dispatch.reset_default_plane()
 
 
+def _apply_mesh_args(args) -> None:
+    """Thread the --devices/--backend/--pod-* seam into the engine:
+    pod flags (or the JEPSEN_TPU_POD_* env they override) join the
+    pod FIRST (jax.distributed must initialize before the first device
+    query), then the mesh policy pins what sharded.resolve_mesh's
+    ambient default_mesh may span."""
+    from jepsen_tpu.checker import sharded
+    from jepsen_tpu.pod import topology
+
+    cfg = None
+    coord = getattr(args, "pod_coordinator", None)
+    if coord:
+        cfg = topology.PodConfig(
+            coordinator=coord,
+            num_processes=int(getattr(args, "pod_processes") or 1),
+            process_id=int(getattr(args, "pod_index") or 0),
+        )
+    topology.init_pod(cfg)
+    sharded.set_mesh_policy(
+        devices=getattr(args, "devices", None),
+        backend=getattr(args, "backend", None),
+    )
+
+
 def cmd_test(args) -> int:
     from jepsen_tpu import store as storelib
     from jepsen_tpu.generator import pure as gen
@@ -262,6 +286,7 @@ def _cmd_analyze(args) -> int:
     from jepsen_tpu.store import Store
 
     _reset_engine_state()
+    _apply_mesh_args(args)
     run_dir = _resolve_run_dir(args.path, args.store)
     if args.follow:
         return _analyze_follow(args, run_dir)
@@ -520,6 +545,7 @@ def cmd_daemon(args) -> int:
     from jepsen_tpu.service.server import CheckerDaemon
 
     _reset_engine_state()
+    _apply_mesh_args(args)
     daemon = CheckerDaemon(
         root=args.store,
         host=args.host,
@@ -577,6 +603,26 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--workload", choices=WORKLOADS,
                         default="register")
 
+    def mesh_args(sp):
+        """The explicit mesh/pod seam (analyze, daemon; bench.py adds
+        the same flags): mesh shape by flag, not only the conftest
+        JEPSEN_TPU_HOST_DEVICES env seam."""
+        sp.add_argument("--devices", type=int, default=None,
+                        help="cap the ambient mesh at N devices "
+                             "(1 forces the single-device path)")
+        sp.add_argument("--backend", default=None,
+                        help="jax platform the mesh spans "
+                             "(cpu/gpu/tpu; default: ambient)")
+        sp.add_argument("--pod-coordinator", default=None,
+                        metavar="HOST:PORT",
+                        help="join a multi-process pod via this "
+                             "coordinator (jax.distributed; overrides "
+                             "JEPSEN_TPU_POD_COORDINATOR)")
+        sp.add_argument("--pod-processes", type=int, default=None,
+                        help="total pod process count")
+        sp.add_argument("--pod-index", type=int, default=None,
+                        help="this process's pod index (0-based)")
+
     t = sub.add_parser("test", help="run a test and analyze it")
     shared(t)
     t.add_argument("--name", default=None)
@@ -596,6 +642,7 @@ def build_parser() -> argparse.ArgumentParser:
         "analyze", help="re-check a stored history (no cluster needed)"
     )
     shared(a)
+    mesh_args(a)
     a.add_argument("path", nargs="?", default="",
                    help="run directory or test name (default: latest)")
     a.add_argument("--resume", action="store_true",
@@ -661,6 +708,7 @@ def build_parser() -> argparse.ArgumentParser:
              "analysis daemon over one warm dispatch plane",
     )
     shared(d)
+    mesh_args(d)
     d.add_argument("--host", default="127.0.0.1")
     d.add_argument("--port", type=int, default=8008)
     d.add_argument("--max-inflight", type=int, default=64,

@@ -31,7 +31,9 @@ def engine_snapshot() -> dict:
       (``floor_amortization``, ``double_buffer_occupancy``)
     - ``launch``:    device-launch accounting (launches, host_syncs,
       escalations, donated_buffers)
-    - ``mesh``:      shard_map engagement + mesh-side resilience view
+    - ``mesh``:      shard_map engagement + mesh-side resilience view,
+      plus the pod ``topology`` block (hosts, local vs. global
+      devices, backend)
     - ``resilience``: chaos-layer retries/quarantines/breakers
     - ``checkpoint``: save/resume/replay/invalidation accounting
     - ``streaming``: incremental-tail appends and tail launches

@@ -1,0 +1,22 @@
+"""Multi-process pod execution: the distributed layer of the plane.
+
+PRs 1-12 built a warm, durable, observable analysis plane that stops at
+the chips of ONE host. This package backs the hosts x chips (DCN x ICI)
+axis layout sharded.py has carried single-process since PR 3 with real
+multi-process execution:
+
+- ``topology``     — the ``jax.distributed.initialize`` seam (env/CLI
+  driven) plus ``topology_snapshot()`` feeding mesh stats and the obs
+  plane (``pod_init`` spans).
+- ``launcher``     — subprocess harness spawning an N-process CPU pod
+  on localhost with a TCP coordinator, so tier-1 runs a REAL
+  two-process mesh (the conftest ``JEPSEN_TPU_HOST_DEVICES`` trick one
+  level up).
+- ``slicing``      — host-local batch slicing: global stacked key
+  batches materialize per-host onto addressable shards only; verdict
+  bitsets all-gather ONCE before the ``_host_get`` funnel.
+- ``faultdomains`` — host-level failure domains: chaos.py's quarantine
+  ladder learns ``host:<i>`` labels so a dead process ejects its whole
+  slice; degradation runs pod -> host-quarantined pod -> local host
+  mesh -> single device -> oracle.
+"""

@@ -1,0 +1,153 @@
+"""Localhost pod launcher: a real N-process mesh for tier-1.
+
+The conftest ``JEPSEN_TPU_HOST_DEVICES`` seam fakes N chips inside one
+process; this is the same trick one level up — N *processes*, each
+with its own XLA client and host-local CPU devices, joined through a
+TCP coordinator on 127.0.0.1 into one global mesh. Tests (and
+``__graft_entry__.dryrun_multichip`` in pod mode, and bench's backend
+matrix ``--pod`` row) use it to pin cross-host behavior — host-local
+placement, the one-allgather collect, host-death fault domains —
+without ever needing a second machine.
+
+Children run ``python -c`` with a prelude that calls
+``topology.init_pod()`` from the env seam, so the supplied script body
+starts INSIDE the initialized pod. The child env deliberately
+overrides inherited ``XLA_FLAGS`` (the parent pytest process pins
+``--xla_force_host_platform_device_count=8``; a pod child wants its
+own local count) and pins ``JAX_PLATFORMS=cpu``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from jepsen_tpu.pod import topology
+
+#: prepended to every child script: join the pod before user code.
+PRELUDE = "import jepsen_tpu.pod.topology as _pod_t; _pod_t.init_pod()\n"
+
+
+@dataclass
+class PodProc:
+    """One finished pod member."""
+
+    process_id: int
+    returncode: Optional[int]
+    stdout: str
+    stderr: str
+
+    @property
+    def ok(self) -> bool:
+        return self.returncode == 0
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port for the coordinator. The tiny
+    bind-release race is acceptable: the coordinator binds within
+    milliseconds and tier-1 runs serially."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def pod_env(
+    coordinator: str,
+    n_procs: int,
+    process_id: int,
+    n_local_devices: int,
+    base_env: Optional[Dict[str, str]] = None,
+) -> Dict[str, str]:
+    """The env one pod child needs: the JEPSEN_TPU_POD_* seam, a CPU
+    backend with exactly ``n_local_devices`` virtual chips, and the
+    repo importable."""
+    env = dict(os.environ if base_env is None else base_env)
+    env[topology.ENV_COORDINATOR] = coordinator
+    env[topology.ENV_NPROCS] = str(n_procs)
+    env[topology.ENV_PROCESS_ID] = str(process_id)
+    env["JAX_PLATFORMS"] = "cpu"
+    # override, don't append: the parent test process already carries
+    # a conflicting device-count flag from conftest.
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={int(n_local_devices)}"
+    )
+    env["PYTHONPATH"] = (
+        _repo_root() + os.pathsep + env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    # Persistent compile cache shared across pod spawns (same per-user
+    # path as bench.py): tier-1 launches several short-lived pods, and
+    # without this every member re-pays the full XLA compile of the
+    # same shard_map programs.
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "jepsen_tpu",
+            "jax_cache",
+        ),
+    )
+    return env
+
+
+def launch_pod(
+    n_procs: int,
+    script: str,
+    *,
+    n_local_devices: int = 4,
+    timeout_s: float = 240.0,
+    python: Optional[str] = None,
+    extra_env: Optional[Dict[str, str]] = None,
+    cwd: Optional[str] = None,
+) -> List[PodProc]:
+    """Spawn an ``n_procs``-process CPU pod on localhost running
+    ``script`` (a Python source string) in every member, and wait for
+    all of them. Pod collectives are barriers: one hung member wedges
+    the rest, so blowing ``timeout_s`` kills the WHOLE pod (survivors
+    would never finish) and the dead members report returncode=None
+    or the kill signal."""
+    coordinator = f"127.0.0.1:{free_port()}"
+    procs: List[subprocess.Popen] = []
+    for pid in range(n_procs):
+        env = pod_env(coordinator, n_procs, pid, n_local_devices)
+        if extra_env:
+            env.update(extra_env)
+        procs.append(
+            subprocess.Popen(
+                [python or sys.executable, "-c", PRELUDE + script],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                cwd=cwd,
+            )
+        )
+    deadline = time.monotonic() + timeout_s
+    out: List[Optional[PodProc]] = [None] * n_procs
+    timed_out = False
+    for pid, p in enumerate(procs):
+        budget = deadline - time.monotonic()
+        try:
+            so, se = p.communicate(timeout=max(budget, 0.1))
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            so, se = p.communicate()
+        out[pid] = PodProc(pid, p.returncode, so or "", se or "")
+    if timed_out:
+        for q in procs:  # reap any member killed after its collect
+            if q.poll() is None:
+                q.kill()
+                q.wait()
+    return [p for p in out if p is not None]

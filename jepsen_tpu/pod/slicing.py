@@ -1,0 +1,78 @@
+"""Host-local batch slicing for pod meshes.
+
+Two asymmetries separate a pod mesh from a single-process one:
+
+- **Placement**: a process can only device_put onto its OWN chips. The
+  global stacked key batch therefore materializes per-host —
+  ``jax.make_array_from_callback`` hands each process just the index
+  slices of the shards it owns (every process holds the same host
+  numpy batch, deterministic by construction, so the global logical
+  value is consistent without any exchange).
+- **Collect**: a sharded output is NOT fully addressable — process 0
+  cannot read process 1's verdict shard. The tiny (alive, overflow,
+  died) bitsets all-gather ONCE through a cached replicating jit
+  (``out_shardings=P()``), after which every process reads the full
+  verdict vector locally through the ordinary ``_host_get`` funnel.
+  The scan itself stays collective-free (keys are independent) and its
+  out specs match the replicator's in specs (SNIPPETS [1]'s
+  out_axis_resources == next in_axis_resources rule), so that single
+  all-gather is the ONLY cross-host round trip a check pays — the
+  one-sync-per-check contract (``syncs_per_check == 1.0``) holds
+  across DCN exactly as it does across ICI.
+
+Single-process, both helpers collapse to the PR 3 paths byte-for-byte
+(plain device_put; no replication), so plain-CPU and GPU meshes run
+the same code the pod does.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from jepsen_tpu.pod.topology import is_multiprocess
+
+
+def host_shard_put(cols: Sequence, mesh: Mesh) -> Tuple:
+    """Place stacked key columns on the mesh with the key-axis
+    sharding: plain device_put single-process; per-host addressable
+    shards only (make_array_from_callback) in a pod."""
+    from jepsen_tpu.checker.sharded import key_spec
+
+    sharding = NamedSharding(mesh, key_spec(mesh))
+    if not is_multiprocess():
+        return tuple(
+            jax.device_put(np.asarray(c), sharding) for c in cols
+        )
+    out = []
+    for c in cols:
+        h = np.asarray(c)
+        out.append(
+            jax.make_array_from_callback(
+                h.shape, sharding, lambda idx, h=h: h[idx]
+            )
+        )
+    return tuple(out)
+
+
+@functools.lru_cache(maxsize=None)
+def _replicator(mesh: Mesh, n: int):
+    """Cached identity jit with replicated out_shardings: one compiled
+    all-gather for an n-tuple of verdict arrays on this mesh."""
+    rep = NamedSharding(mesh, P())
+    return jax.jit(lambda *xs: xs, out_shardings=(rep,) * n)
+
+
+def global_view(arrs: Tuple, mesh) -> Tuple:
+    """Make sharded outputs fully addressable on every process: a
+    no-op single-process (the arrays already are); in a pod the tuple
+    rides ONE replicating all-gather. Call this immediately before the
+    ``_host_get`` funnel — it is device->device, so the sync
+    accounting (one _host_get per check) is unchanged."""
+    if mesh is None or not is_multiprocess():
+        return arrs
+    return _replicator(mesh, len(arrs))(*arrs)

@@ -1,0 +1,194 @@
+"""Pod topology: the ``jax.distributed.initialize`` seam.
+
+One function — ``init_pod`` — owns every process-global decision a
+multi-process mesh needs, and it must run BEFORE the first device
+query (JAX pins the backend on first touch):
+
+- CPU pods flip ``jax_cpu_collectives_implementation`` to gloo first;
+  without it XLA:CPU rejects any cross-process computation
+  ("Multiprocess computations aren't implemented on the CPU backend").
+  TPU/GPU pods keep their native ICI/DCN + NCCL transports.
+- ``jax.distributed.initialize`` connects to the TCP coordinator
+  (process 0 serves it) with the (coordinator, num_processes,
+  process_id) triple from explicit config, CLI flags, or the
+  ``JEPSEN_TPU_POD_*`` env seam — the same layering as the conftest
+  ``JEPSEN_TPU_HOST_DEVICES`` seam one level down.
+
+``topology_snapshot()`` is the read side: hosts, local vs. global
+devices, backend — folded into ``sharded.mesh_stats_snapshot()`` (and
+through it the consolidated ``obs.snapshot.engine_snapshot``), and
+emitted as a ``pod_init`` span on the flight recorder at init time.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from jepsen_tpu.obs import trace as obs_trace
+
+#: env seam: set on every pod child by launcher.pod_env (and readable
+#: by operators driving real pods). CLI flags override env.
+ENV_COORDINATOR = "JEPSEN_TPU_POD_COORDINATOR"
+ENV_NPROCS = "JEPSEN_TPU_POD_NPROCS"
+ENV_PROCESS_ID = "JEPSEN_TPU_POD_PROCESS_ID"
+
+
+@dataclass(frozen=True)
+class PodConfig:
+    """The (coordinator, num_processes, process_id) triple
+    jax.distributed.initialize needs."""
+
+    coordinator: str
+    num_processes: int
+    process_id: int
+
+    @classmethod
+    def from_env(cls, env=None) -> Optional["PodConfig"]:
+        """Read the JEPSEN_TPU_POD_* seam; None when no coordinator is
+        set (the ordinary single-process case)."""
+        env = os.environ if env is None else env
+        addr = env.get(ENV_COORDINATOR)
+        if not addr:
+            return None
+        return cls(
+            coordinator=addr,
+            num_processes=int(env.get(ENV_NPROCS, "1")),
+            process_id=int(env.get(ENV_PROCESS_ID, "0")),
+        )
+
+
+#: what init_pod decided, for the read side. Locked like every stats
+#: surface; "initialized" flips exactly once per process.
+POD_STATS = {
+    "initialized": False,
+    "coordinator": None,
+    "n_hosts_configured": 1,
+    "process_id_configured": 0,
+}
+
+_pod_stats_lock = threading.Lock()
+_init_lock = threading.Lock()
+#: claimed under _init_lock by the thread doing the (slow) coordinator
+#: handshake so the handshake itself can run with no lock held
+_init_pending = [False]
+
+
+def _want_gloo() -> bool:
+    """Whether this pod runs on the CPU backend (gloo required). Read
+    from configuration only — probing jax.default_backend() here would
+    initialize the backend before distributed init."""
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if not plats:
+        try:
+            import jax
+
+            plats = jax.config.read("jax_platforms") or ""
+        except Exception:
+            plats = ""
+    return plats.split(",")[0].strip().lower() == "cpu"
+
+
+def init_pod(config: Optional[PodConfig] = None,
+             timeout_s: float = 60.0) -> dict:
+    """Join (or skip joining) a pod; returns topology_snapshot().
+
+    config=None reads the JEPSEN_TPU_POD_* env seam; no coordinator
+    there (or num_processes < 2) means single-process — nothing is
+    touched and jax is not even imported. Idempotent: the second call
+    in a process returns the snapshot without re-initializing.
+    """
+    with _init_lock:
+        if POD_STATS["initialized"] or _init_pending[0]:
+            return topology_snapshot()
+        cfg = config if config is not None else PodConfig.from_env()
+        if cfg is None or cfg.num_processes < 2:
+            return topology_snapshot()
+        _init_pending[0] = True
+    # The handshake (and its span) runs with no lock held: the
+    # coordinator connect can block for timeout_s, and span emission
+    # takes the recorder's ring-registry lock.
+    try:
+        import jax
+
+        if _want_gloo():
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo"
+                )
+            except Exception:  # pragma: no cover - jaxlib w/o gloo
+                pass
+        with obs_trace.span(
+            "pod_init", kind="pod",
+            coordinator=cfg.coordinator,
+            n_hosts=cfg.num_processes,
+            process_id=cfg.process_id,
+        ):
+            jax.distributed.initialize(
+                coordinator_address=cfg.coordinator,
+                num_processes=cfg.num_processes,
+                process_id=cfg.process_id,
+                initialization_timeout=int(timeout_s),
+            )
+        with _pod_stats_lock:
+            POD_STATS["initialized"] = True
+            POD_STATS["coordinator"] = cfg.coordinator
+            POD_STATS["n_hosts_configured"] = cfg.num_processes
+            POD_STATS["process_id_configured"] = cfg.process_id
+    finally:
+        with _init_lock:
+            _init_pending[0] = False
+    return topology_snapshot()
+
+
+def topology_snapshot() -> dict:
+    """Hosts / local vs. global devices / backend, as this process
+    sees them. Never forces backend initialization on its own: live
+    jax queries run only once jax is already imported (by then the
+    caller is on a jax-backed path anyway), so stdlib-only consumers
+    (planelint, the service door) can read the configured block for
+    free."""
+    with _pod_stats_lock:
+        out = {
+            "initialized": POD_STATS["initialized"],
+            "coordinator": POD_STATS["coordinator"],
+            "n_hosts": 1,
+            "process_index": 0,
+            "local_devices": 0,
+            "global_devices": 0,
+            "backend": None,
+        }
+    if "jax" not in sys.modules:
+        return out
+    import jax
+
+    try:
+        out["n_hosts"] = int(jax.process_count())
+        out["process_index"] = int(jax.process_index())
+        out["local_devices"] = len(jax.local_devices())
+        out["global_devices"] = len(jax.devices())
+        out["backend"] = str(jax.default_backend())
+    except Exception:  # backend not up yet: configured block only
+        pass
+    return out
+
+
+def is_multiprocess() -> bool:
+    """True inside an initialized pod (>1 process). Safe pre-init and
+    pre-import: False."""
+    if "jax" not in sys.modules:
+        return False
+    import jax
+
+    try:
+        return int(jax.process_count()) > 1
+    except Exception:
+        return False
+
+
+def host_of(device) -> int:
+    """The failure-domain id of a device: its owning process index."""
+    return int(getattr(device, "process_index", 0))

@@ -89,6 +89,11 @@ def test_graft_entry_contract(capfd):
     # Device residency rides the metric line: a timed whole-batch
     # check pays the tunnel sync floor exactly once.
     assert rec["syncs_per_check"] == 1.0
+    # Pod topology rides the same line: a single-process dryrun is a
+    # one-host pod on the CPU backend, and the driver reads both
+    # fields when it assembles the backend matrix.
+    assert rec["n_hosts"] == 1
+    assert rec["backend"] == "cpu"
     # Resilience accounting rides the same line: a clean dryrun
     # publishes integer zeros (nonzero means faults were survived).
     assert isinstance(rec["retries"], int) and rec["retries"] >= 0
