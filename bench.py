@@ -1465,6 +1465,21 @@ def main() -> None:
                 f"({len(_lint_new)} planelint finding(s) above); fix "
                 "them or rerun with --allow-dirty-lint"
             )
+        # A shrunken rule catalog would make "lint-clean" vacuous:
+        # all five families (incl. D lockorder / E determinism) must
+        # be active before the number is publishable.
+        _rules_total = analysis.rules_total()
+        if _rules_total < 22:
+            raise SystemExit(
+                f"bench: planelint catalog shrank to {_rules_total} "
+                "rules (< 22): a family is disabled; refusing to "
+                "publish"
+            )
+        print(
+            f"bench: planelint clean ({_rules_total} rules, "
+            "0 new findings)",
+            file=sys.stderr,
+        )
 
     # Gate BEFORE importing jax: plugin registration itself can touch
     # the wedged tunnel and hang the parent uninterruptibly — smoke

@@ -30,8 +30,13 @@ import ast
 import re
 from typing import List, Optional
 
+from jepsen_tpu.analysis.callgraph import (
+    BLOCKING_ATTRS as _BLOCKING_ATTRS,
+    BLOCKING_DOTTED_TAILS as _BLOCKING_DOTTED_TAILS,
+    _dotted,
+    _last_seg,
+)
 from jepsen_tpu.analysis.findings import Finding
-from jepsen_tpu.analysis.hotpath import _dotted, _last_seg
 
 #: guarded shared structures: module-level stats dicts + the chaos
 #: quarantine ledger
@@ -45,14 +50,10 @@ _MUTATORS = {
     "extend", "insert", "remove", "__setitem__",
 }
 
-#: attribute calls that block (or can block) the calling thread.
-#: ``wait`` is excluded on purpose: Condition.wait RELEASES the lock.
-_BLOCKING_ATTRS = {
-    "join", "result", "recv", "recv_into", "send", "sendall",
-    "accept", "connect",
-}
-#: dotted calls that block
-_BLOCKING_DOTTED_TAILS = {"sleep"}  # time.sleep / _time.sleep
+# the blocking-call sets now live in callgraph.py (imported above):
+# JT202 (this family, lexical) and JT403 (Family D, interprocedural)
+# must agree on what "blocking" means or they partition the hazard
+# incorrectly.
 
 #: hook-shaped callee names (JT204)
 _HOOK_RE = re.compile(

@@ -77,6 +77,36 @@ class Finding:
 # --------------------------------------------------------------------
 
 
+def scan_suppression_entries(
+    source: str,
+) -> List[Tuple[int, Tuple[str, ...], str]]:
+    """Every planelint disable comment in ``source`` as
+    (governed line, sorted rule ids, reason-or-empty). The shared
+    scanner behind ``parse_suppressions`` and the census."""
+    entries: List[Tuple[int, Tuple[str, ...], str]] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = tuple(sorted(
+                r.strip() for r in m.group(1).split(",") if r.strip()
+            ))
+            line = tok.start[0]
+            reason = (m.group(2) or "").strip()
+            # A comment alone on its line governs the NEXT line; a
+            # trailing comment governs its own.
+            prefix = tok.line[: tok.start[1]]
+            target = line + 1 if not prefix.strip() else line
+            entries.append((target if reason else line, rules, reason))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # the ast parse will report the real syntax problem
+    return entries
+
+
 def parse_suppressions(
     source: str,
 ) -> Tuple[Dict[int, set], List[Tuple[int, str]]]:
@@ -88,29 +118,11 @@ def parse_suppressions(
     """
     suppressed: Dict[int, set] = {}
     bare: List[Tuple[int, str]] = []
-    try:
-        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
-        for tok in tokens:
-            if tok.type != tokenize.COMMENT:
-                continue
-            m = _SUPPRESS_RE.search(tok.string)
-            if not m:
-                continue
-            rules = {
-                r.strip() for r in m.group(1).split(",") if r.strip()
-            }
-            line = tok.start[0]
-            reason = (m.group(2) or "").strip()
-            if not reason:
-                bare.append((line, ",".join(sorted(rules))))
-                continue
-            # A comment alone on its line governs the NEXT line; a
-            # trailing comment governs its own.
-            prefix = tok.line[: tok.start[1]]
-            target = line + 1 if not prefix.strip() else line
-            suppressed.setdefault(target, set()).update(rules)
-    except tokenize.TokenizeError:
-        pass  # the ast parse will report the real syntax problem
+    for line, rules, reason in scan_suppression_entries(source):
+        if not reason:
+            bare.append((line, ",".join(rules)))
+            continue
+        suppressed.setdefault(line, set()).update(rules)
     return suppressed, bare
 
 

@@ -31,6 +31,11 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
+from jepsen_tpu.analysis.callgraph import (
+    _dotted,
+    _last_seg,
+    reachable_closure,
+)
 from jepsen_tpu.analysis.findings import Finding
 
 #: host coercers whose call on a device value forces a sync
@@ -58,26 +63,6 @@ _GUARDS = {"resilient_call", "run_with_deadline", "_guard", "guard"}
 _ACCOUNTING = {"_bump_launch", "note_sharded_launch"}
 #: factory prefixes returning device callables
 _FACTORY_PREFIXES = ("make_sharded_",)
-
-
-def _dotted(node: ast.AST) -> Optional[str]:
-    """'jax.device_get'-style dotted path for Name/Attribute chains."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def _last_seg(node: ast.AST) -> Optional[str]:
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    return None
 
 
 def _is_jit_wrapper_call(call: ast.Call) -> Optional[ast.Call]:
@@ -202,25 +187,14 @@ class ModuleInfo:
                         n = _dotted(a)
                         if n:
                             seeds.add(n.rsplit(".", 1)[-1])
-        self.traced = set(seeds)
-        frontier = list(seeds)
-        while frontier:
-            name = frontier.pop()
-            for fn in defs_by_name.get(name, []):
-                for sub in ast.walk(fn):
-                    if not isinstance(sub, ast.Call):
-                        continue
-                    callee = _last_seg(sub.func)
-                    if (
-                        callee
-                        and callee in defs_by_name
-                        and callee not in self.traced
-                        and callee not in _LAUNDER
-                        and callee not in _ACCOUNTING
-                        and callee not in _GUARDS
-                    ):
-                        self.traced.add(callee)
-                        frontier.append(callee)
+        # the shared interprocedural fixpoint (callgraph.py) with the
+        # funnel/accounting/guard names exempted: crossing one of them
+        # is leaving traced code.
+        self.traced = reachable_closure(
+            defs_by_name,
+            seeds,
+            exempt=frozenset(_LAUNDER | _ACCOUNTING | _GUARDS),
+        )
 
         # third pass: device-returning plain defs (one level deep)
         for node in tree.body:

@@ -491,30 +491,77 @@ def cmd_lint(args) -> int:
     Exit 0 when every finding is inline-suppressed or baselined, 5
     when non-baselined findings remain. --update-baseline rewrites
     planelint_baseline.json with the current findings (grandfathering
-    them); --json emits the machine-readable report the CI preflight
-    parses. Stdlib-ast only: no jax import, so it runs anywhere."""
+    them, and pruning entries whose file::symbol no longer exists);
+    --changed-only scopes findings to the files git considers changed
+    (the call graph still spans the whole package); --sarif writes
+    the new findings as SARIF 2.1.0 for CI annotation; --json emits
+    the machine-readable report (findings, per-rule descriptions,
+    suppression census) the CI preflight parses. Stdlib-ast only: no
+    jax import, so it runs anywhere."""
     import json
 
     from jepsen_tpu import analysis
 
     root = args.root or analysis.package_root()
     baseline_path = args.baseline or analysis.default_baseline_path()
-    findings = analysis.run_lint(root)
+    only = None
+    if args.changed_only:
+        only = analysis.changed_files(root)
+        if not args.json:
+            print(
+                f"planelint: --changed-only scope: "
+                f"{len(only)} file(s)"
+            )
+    findings = analysis.run_lint(root, only=only)
+    baseline = analysis.load_baseline(baseline_path)
+    stale = analysis.stale_baseline_entries(baseline, root)
+    for key in stale:
+        print(
+            f"planelint: warning: stale baseline entry {key} "
+            "(file or symbol no longer exists)",
+            file=sys.stderr,
+        )
     if args.update_baseline:
         analysis.save_baseline(baseline_path, findings)
         print(
             f"planelint: baselined {len(findings)} finding(s) into "
             f"{baseline_path}"
+            + (f" (pruned {len(stale)} stale entries)" if stale else "")
         )
         return EXIT_VALID
-    baseline = analysis.load_baseline(baseline_path)
     new, matched = analysis.apply_baseline(findings, baseline)
+    if args.sarif:
+        doc = analysis.to_sarif(new, analysis.RULES)
+        errors = analysis.validate_sarif(doc)
+        if errors:  # never ship a SARIF a CI ingester would drop
+            for e in errors:
+                print(f"planelint: sarif: {e}", file=sys.stderr)
+            return EXIT_CRASH
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        if not args.json:
+            print(
+                f"planelint: wrote {len(new)} finding(s) to "
+                f"{args.sarif}"
+            )
     if args.json:
         print(json.dumps({
             "findings": [f.to_dict() for f in new],
             "baselined": sum(matched.values()),
             "total": len(findings),
             "clean": not new,
+            "rules_total": analysis.rules_total(),
+            "rules": {
+                rid: {"title": title, "invariant": invariant}
+                for rid, (title, invariant) in sorted(
+                    analysis.RULES.items()
+                )
+            },
+            "suppressions": analysis.suppression_census(
+                root, only=only
+            ),
+            "stale_baseline": stale,
         }, indent=2))
     else:
         for f in new:
@@ -522,7 +569,8 @@ def cmd_lint(args) -> int:
         print(
             f"planelint: {len(new)} finding(s) "
             f"({sum(matched.values())} baselined, "
-            f"{len(findings)} total)"
+            f"{len(findings)} total, "
+            f"{analysis.rules_total()} rules)"
         )
     return EXIT_LINT_DIRTY if new else EXIT_VALID
 
@@ -694,7 +742,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="machine-readable findings report")
     ln.add_argument("--update-baseline", action="store_true",
                     help="grandfather the current findings into the "
-                         "baseline instead of failing on them")
+                         "baseline instead of failing on them "
+                         "(prunes stale entries)")
+    ln.add_argument("--sarif", default=None, metavar="PATH",
+                    help="write new findings as SARIF 2.1.0 (for CI "
+                         "annotation)")
+    ln.add_argument("--changed-only", action="store_true",
+                    help="scope findings to the files git considers "
+                         "changed vs HEAD (graph still spans the "
+                         "package)")
     ln.set_defaults(fn=cmd_lint)
 
     s = sub.add_parser("serve", help="web dashboard over the store")

@@ -31,6 +31,18 @@ pytestmark = pytest.mark.lint
 # --------------------------------------------------------------------
 
 CASES = {
+    "JT000": (
+        # a syntax error hides every other finding in the file —
+        # report it instead of silently skipping the file
+        """
+def f(:
+    pass
+""",
+        """
+def f():
+    pass
+""",
+    ),
     "JT001": (
         # bare suppression: waives an invariant without saying why
         """
@@ -334,6 +346,164 @@ def f(a):
     return scan(a)
 """,
     ),
+    "JT401": (
+        # ABBA: two locks nested in conflicting orders across
+        # functions — the classic latent deadlock
+        """
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+def f():
+    with _lock_a:
+        with _lock_b:
+            pass
+
+def g():
+    with _lock_b:
+        with _lock_a:
+            pass
+""",
+        """
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+def f():
+    with _lock_a:
+        with _lock_b:
+            pass
+
+def g():
+    with _lock_a:
+        with _lock_b:
+            pass
+""",
+    ),
+    "JT402": (
+        # collective issued while a plane lock is held: a member
+        # parked on the lock wedges every peer in the barrier
+        """
+import threading
+
+_lock = threading.Lock()
+
+def f(arrs, mesh):
+    with _lock:
+        return global_view(arrs, mesh)
+""",
+        """
+import threading
+
+_lock = threading.Lock()
+
+def f(arrs, mesh):
+    with _lock:
+        n = len(arrs)
+    return global_view(arrs, mesh)
+""",
+    ),
+    "JT403": (
+        # blocking call reachable under a lock THROUGH a callee —
+        # the interprocedural closure of JT202 (a direct join under
+        # the lock is JT202's, not this rule's)
+        """
+import threading
+
+_lock = threading.Lock()
+
+def _drain(t):
+    t.join()
+
+def f(t):
+    with _lock:
+        _drain(t)
+""",
+        """
+import threading
+
+_lock = threading.Lock()
+
+def _drain(t):
+    t.join()
+
+def f(t):
+    with _lock:
+        n = 1
+    _drain(t)
+""",
+    ),
+    "JT501": (
+        # collective under a process_index-dependent branch: SPMD
+        # divergence — member 0 enters the barrier, the rest never do
+        """
+import jax
+
+def f(arrs, mesh):
+    if jax.process_index() == 0:
+        return global_view(arrs, mesh)
+    return None
+""",
+        # is_multiprocess() is pod-uniform: every member takes the
+        # same arm, so gating a collective on it is sanctioned
+        """
+def f(arrs, mesh):
+    if is_multiprocess():
+        return global_view(arrs, mesh)
+    return None
+""",
+    ),
+    "JT502": (
+        # branch arms reach the same collectives in different orders:
+        # members on different arms cross-match barriers
+        """
+def f(arrs, mesh, fast):
+    if fast:
+        a = global_view(arrs, mesh)
+        b = init_pod()
+    else:
+        b = init_pod()
+        a = global_view(arrs, mesh)
+    return a, b
+""",
+        """
+def f(arrs, mesh, fast):
+    if fast:
+        a = global_view(arrs, mesh)
+        b = init_pod()
+    else:
+        a = global_view(arrs, mesh)
+        b = init_pod()
+    return a, b
+""",
+    ),
+    "JT503": (
+        # wall-clock time flowing into a hashlib funnel: the durable
+        # identity changes per run, breaking resume and coalescing
+        """
+import hashlib
+import time
+
+def f(rows):
+    h = hashlib.sha256()
+    h.update(str(time.time()).encode())
+    return h.hexdigest()
+""",
+        # sorted() launders set-iteration order — the sanctioned
+        # spelling for hashing a set's contents
+        """
+import hashlib
+
+def f():
+    items = {"a", "b"}
+    h = hashlib.sha256()
+    for k in sorted(items):
+        h.update(k.encode())
+    return h.hexdigest()
+""",
+    ),
 }
 
 
@@ -356,9 +526,21 @@ def test_rule_negative_is_clean(rule):
 
 
 def test_rule_catalog_covers_corpus():
-    # every corpus rule is documented, and vice versa (JT000 is the
-    # parse-failure escape hatch, not a documented rule)
+    # every corpus rule is documented, and vice versa
     assert set(CASES) == set(analysis.RULES)
+
+
+def test_rule_catalog_partitions_by_family():
+    # the catalog is exactly the meta rules plus the five families,
+    # with no rule claimed twice
+    family_rules = [
+        r for fam in sorted(analysis.FAMILY_RULES)
+        for r in analysis.FAMILY_RULES[fam]
+    ]
+    all_rules = list(analysis.META_RULES) + family_rules
+    assert len(all_rules) == len(set(all_rules))
+    assert set(all_rules) == set(analysis.RULES)
+    assert analysis.rules_total() == len(analysis.RULES) == 22
 
 
 def test_host_get_funnel_itself_is_exempt():
@@ -392,6 +574,109 @@ def _impl(a):
 scan = jax.jit(_impl)
 """
     assert lint_source(src, rel="checker/corpus.py") == []
+
+
+# --------------------------------------------------------------------
+# The interprocedural core: D/E rules see cross-file edges
+# --------------------------------------------------------------------
+
+
+def test_lockorder_sees_cross_file_cycles():
+    # the ABBA halves live in different modules, linked by
+    # from-imports: only the package-wide call graph can see the cycle
+    import ast
+
+    from jepsen_tpu.analysis.lockorder import check_lockorder
+
+    m1 = """
+import threading
+
+from jepsen_tpu.checker.m2 import locked_b
+
+_lock_a = threading.Lock()
+
+def locked_a():
+    with _lock_a:
+        pass
+
+def f():
+    with _lock_a:
+        locked_b()
+"""
+    m2 = """
+import threading
+
+from jepsen_tpu.checker.m1 import locked_a
+
+_lock_b = threading.Lock()
+
+def locked_b():
+    with _lock_b:
+        pass
+
+def g():
+    with _lock_b:
+        locked_a()
+"""
+    graph = analysis.CallGraph.from_trees({
+        "checker/m1.py": ast.parse(m1),
+        "checker/m2.py": ast.parse(m2),
+    })
+    found = check_lockorder(graph, {"checker/m1.py", "checker/m2.py"})
+    assert [f.rule for f in found] == ["JT401"]
+    assert "m1.py::_lock_a" in found[0].message
+    assert "m2.py::_lock_b" in found[0].message
+
+
+def test_lock_identity_is_module_qualified():
+    # two modules each with their own _stats_lock nesting under a
+    # shared ordering must NOT alias into a false ABBA cycle
+    import ast
+
+    from jepsen_tpu.analysis.lockorder import check_lockorder
+
+    template = """
+import threading
+
+_outer = threading.Lock()
+_stats_lock = threading.Lock()
+
+def f():
+    with _outer:
+        with _stats_lock:
+            pass
+"""
+    graph = analysis.CallGraph.from_trees({
+        "checker/m1.py": ast.parse(template),
+        "checker/m2.py": ast.parse(template),
+    })
+    found = check_lockorder(graph, {"checker/m1.py", "checker/m2.py"})
+    assert found == []
+
+
+def test_repo_lock_order_graph_is_substantive():
+    # the real tree's graph is not vacuous: it has plane locks, edges
+    # between them, and functions that reach collectives/blocking
+    # calls — the analyses above are judging something real
+    import ast
+
+    trees = {}
+    root = analysis.package_root()
+    for dirpath, _, filenames in os.walk(root):
+        for name in filenames:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                try:
+                    trees[rel] = ast.parse(f.read())
+                except SyntaxError:
+                    pass
+    graph = analysis.CallGraph.from_trees(trees)
+    assert len(graph.nodes) > 500
+    assert len(graph.collective_witness()) > 0
+    assert len(graph.blocking_witness()) > 50
 
 
 # --------------------------------------------------------------------
@@ -445,6 +730,50 @@ def f(x):
     assert lint_source(src, rel="checker/corpus.py") == []
 
 
+def test_suppression_reason_may_contain_commas_and_equals():
+    # the reason is free text: commas and = signs must not be eaten
+    # by the rule-list or key=value parsing
+    from jepsen_tpu.analysis import scan_suppression_entries
+
+    src = (
+        "x = 1  # planelint: disable=JT205,JT101 "
+        "reason=serialized by design, see PR 7; invariant=held\n"
+    )
+    entries = scan_suppression_entries(src)
+    assert entries == [
+        (1, ("JT101", "JT205"),
+         "serialized by design, see PR 7; invariant=held"),
+    ]
+
+
+def test_suppression_with_comma_reason_still_suppresses():
+    src = """
+import jax.numpy as jnp
+
+def f():
+    x = jnp.sum(jnp.arange(4))
+    return float(x)  # planelint: disable=JT101 reason=a, b unpacking = ok
+"""
+    assert lint_source(src, rel="checker/corpus.py") == []
+
+
+def test_suppression_scanner_survives_syntax_errors():
+    # a broken file still yields its suppression entries (tokenize
+    # succeeds where ast.parse fails) and lints as exactly JT000
+    from jepsen_tpu.analysis import scan_suppression_entries
+
+    src = """
+x = 1  # planelint: disable=JT101 reason=still scanned
+def f(:
+    pass
+"""
+    assert scan_suppression_entries(src) == [
+        (2, ("JT101",), "still scanned"),
+    ]
+    found = lint_source(src, rel="checker/corpus.py")
+    assert [f.rule for f in found] == ["JT000"]
+
+
 # --------------------------------------------------------------------
 # Baseline round trip
 # --------------------------------------------------------------------
@@ -486,6 +815,59 @@ def test_missing_baseline_file_is_empty():
     assert load_baseline("/nonexistent/baseline.json") == {}
 
 
+def test_stale_baseline_entries_detects_dead_keys(tmp_path):
+    root = tmp_path / "pkg"
+    pkg = root / "checker"
+    pkg.mkdir(parents=True)
+    (pkg / "streaming.py").write_text("def f():\n    pass\n")
+    baseline = {
+        "checker/streaming.py::f::JT104": 1,      # live
+        "checker/streaming.py::gone::JT104": 1,   # symbol deleted
+        "checker/deleted.py::f::JT104": 1,        # file deleted
+        "malformed-key": 1,
+    }
+    assert analysis.stale_baseline_entries(baseline, str(root)) == [
+        "checker/deleted.py::f::JT104",
+        "checker/streaming.py::gone::JT104",
+        "malformed-key",
+    ]
+
+
+# --------------------------------------------------------------------
+# SARIF export
+# --------------------------------------------------------------------
+
+
+def test_sarif_emitter_validates_and_carries_findings():
+    pos, _ = CASES["JT104"]
+    found = lint_source(pos, rel="checker/corpus.py")
+    doc = analysis.to_sarif(found, analysis.RULES)
+    assert analysis.validate_sarif(doc) == []
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "planelint"
+    results = run["results"]
+    assert len(results) == 1 and results[0]["ruleId"] == "JT104"
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == (
+        "jepsen_tpu/checker/corpus.py"
+    )
+    assert loc["region"]["startLine"] >= 1
+    # cross-check the stdlib validator against the real jsonschema
+    # package when the environment has it
+    try:
+        import jsonschema
+    except ImportError:
+        return
+    jsonschema.validate(doc, analysis.MINIMAL_SCHEMA)
+
+
+def test_sarif_validator_rejects_malformed_docs():
+    assert analysis.validate_sarif({"version": "2.1.0"}) != []
+    doc = analysis.to_sarif([], analysis.RULES)
+    doc["runs"][0]["tool"]["driver"].pop("name")
+    assert analysis.validate_sarif(doc) != []
+
+
 # --------------------------------------------------------------------
 # CLI contract + the repo-clean tier-1 gate
 # --------------------------------------------------------------------
@@ -517,6 +899,110 @@ def test_cli_json_contract():
     rec = json.loads(proc.stdout)
     assert rec["clean"] is True
     assert rec["findings"] == []
+    # per-rule descriptions and the catalog size ride the report
+    assert rec["rules_total"] == analysis.rules_total() == 22
+    assert set(rec["rules"]) == set(analysis.RULES)
+    for meta in rec["rules"].values():
+        assert meta["title"] and meta["invariant"]
+    # suppression census: every waived invariant is on the record
+    # with file/line/reason per site (this tree has reasoned JT402/
+    # JT403 suppressions at the phase-serializer locks)
+    census = rec["suppressions"]
+    assert "JT402" in census and "JT403" in census
+    for ent in census.values():
+        assert ent["count"] == len(ent["sites"]) >= 1
+        for site in ent["sites"]:
+            assert set(site) == {"file", "line", "reason"}
+            assert site["reason"]
+    assert rec["stale_baseline"] == []
+
+
+def test_cli_sarif_output_validates(tmp_path):
+    out = tmp_path / "lint.sarif"
+    proc = _run_cli("--sarif", str(out))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(out.read_text())
+    assert analysis.validate_sarif(doc) == []
+    assert doc["version"] == "2.1.0"
+    driver = doc["runs"][0]["tool"]["driver"]
+    assert len(driver["rules"]) == analysis.rules_total()
+    assert doc["runs"][0]["results"] == []  # clean tree
+
+
+def _git(*args, cwd):
+    subprocess.run(
+        ["git", "-c", "user.email=t@example.com", "-c", "user.name=t",
+         *args],
+        cwd=cwd, check=True, capture_output=True,
+    )
+
+
+def test_changed_files_tracks_git_diff(tmp_path):
+    root = tmp_path / "pkg"
+    pkg = root / "checker"
+    pkg.mkdir(parents=True)
+    (pkg / "clean.py").write_text("X = 1\n")
+    modified = pkg / "modified.py"
+    modified.write_text("Y = 1\n")
+    _git("init", "-q", cwd=tmp_path)
+    _git("add", "-A", cwd=tmp_path)
+    _git("commit", "-q", "-m", "seed", cwd=tmp_path)
+    modified.write_text("Y = 2\n")
+    (pkg / "untracked.py").write_text("Z = 1\n")
+    (pkg / "notes.txt").write_text("not python\n")
+    assert analysis.changed_files(str(root)) == [
+        "checker/modified.py",
+        "checker/untracked.py",
+    ]
+
+
+def test_cli_changed_only_scopes_findings(tmp_path):
+    # two dirty files by content, but only one is git-changed: the
+    # committed one's findings stay out of a --changed-only run
+    root = tmp_path / "pkg"
+    pkg = root / "checker"
+    pkg.mkdir(parents=True)
+    (pkg / "streaming.py").write_text(CASES["JT104"][0])
+    _git("init", "-q", cwd=tmp_path)
+    _git("add", "-A", cwd=tmp_path)
+    _git("commit", "-q", "-m", "seed", cwd=tmp_path)
+    (pkg / "sharded.py").write_text(CASES["JT104"][0])  # untracked
+    baseline = str(tmp_path / "baseline.json")
+    proc = _run_cli("--root", str(root), "--baseline", baseline)
+    assert proc.returncode == 5, proc.stdout + proc.stderr
+    assert "streaming.py:" in proc.stdout
+    assert "sharded.py:" in proc.stdout
+    proc = _run_cli(
+        "--root", str(root), "--baseline", baseline, "--changed-only"
+    )
+    assert proc.returncode == 5, proc.stdout + proc.stderr
+    assert "sharded.py:" in proc.stdout
+    assert "streaming.py:" not in proc.stdout
+
+
+def test_update_baseline_warns_and_prunes_stale_entries(tmp_path):
+    root = tmp_path / "pkg"
+    pkg = root / "checker"
+    pkg.mkdir(parents=True)
+    (pkg / "streaming.py").write_text(CASES["JT104"][0])
+    baseline_path = tmp_path / "baseline.json"
+    baseline_path.write_text(json.dumps({
+        "version": 1,
+        "findings": {"checker/gone.py::f::JT104": 1},
+    }))
+    proc = _run_cli("--root", str(root), "--baseline", str(baseline_path))
+    assert proc.returncode == 5, proc.stdout + proc.stderr
+    assert (
+        "stale baseline entry checker/gone.py::f::JT104" in proc.stderr
+    )
+    proc = _run_cli(
+        "--root", str(root), "--baseline", str(baseline_path),
+        "--update-baseline",
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pruned 1 stale" in proc.stdout
+    baseline = load_baseline(str(baseline_path))
+    assert baseline == {"checker/streaming.py::f::JT104": 1}
 
 
 def test_cli_exit_codes_on_dirty_tree(tmp_path):

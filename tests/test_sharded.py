@@ -103,6 +103,13 @@ def test_graft_entry_contract(capfd):
     # findings (hot-path residency + lock discipline hold at review
     # time, not just at runtime).
     assert rec["lint_findings"] == 0
+    # ... and names the rule catalog that judged it: all five
+    # families (A hotpath, B concurrency, C obsrules, D lockorder,
+    # E podrules/determinism) plus the meta rules.
+    from jepsen_tpu import analysis
+
+    assert rec["lint_rules_total"] == analysis.rules_total()
+    assert rec["lint_rules_total"] >= 22
     # Flight-recorder liveness rides the same line: the dryrun runs
     # traced, so the metric that claims the floor was paid once comes
     # with the timeline that shows where.

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Diff-scoped planelint for pre-push hooks and CI annotation.
+#
+# Lints only the files git considers changed vs HEAD (the
+# interprocedural call graph still spans the whole package, so
+# lock-order and reachability rules see every edge) and writes the
+# findings as SARIF 2.1.0 for ingestion by code-review tooling.
+#
+# Usage: tools/lint-changed.sh [sarif-out]   (default: lint.sarif)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+SARIF_OUT="${1:-lint.sarif}"
+exec python -m jepsen_tpu.cli lint --changed-only --sarif "$SARIF_OUT"
