@@ -97,6 +97,114 @@ def _time(fn, reps=1):
     return best, out
 
 
+# -- bench trend ledger ------------------------------------------------------
+#
+# One compact row per bench run, appended to a durable JSONL ledger so
+# the perf story stays observable ACROSS runs (cli perf-trend renders
+# the trajectory and gates on vs_baseline regressions). The big JSON
+# record is the full evidence; the trend row is the time series.
+
+TREND_LEDGER_PATH = "bench_runs/trend.jsonl"
+
+
+def trend_row_from_record(record: dict, *, ts=None, smoke=None) -> dict:
+    """The compact per-run trend row: exactly the columns cli
+    perf-trend renders and gates on, pulled from the bench's final
+    JSON record."""
+    import datetime
+
+    residency = record.get("residency") or {}
+    return {
+        "ts": ts or datetime.datetime.now(
+            datetime.timezone.utc
+        ).isoformat(timespec="seconds"),
+        "ops_per_sec": record.get("value"),
+        "vs_baseline": record.get("vs_baseline"),
+        "vs_python_oracle": record.get("vs_python_oracle"),
+        "syncs_per_check": residency.get("syncs_per_check"),
+        "sync_floor_ms": record.get("sync_floor_ms"),
+        "double_buffer_occupancy": residency.get(
+            "double_buffer_occupancy"
+        ),
+        "trace_overhead_pct": record.get("trace_overhead_pct"),
+        # smoke rows are flow validations, not measurements; the flag
+        # rides along so a reader never compares across the boundary
+        # unknowingly (the gate still compares — a smoke row is the
+        # operator's explicit choice to publish one).
+        "smoke": bool(SMOKE if smoke is None else smoke),
+    }
+
+
+def append_trend_row(row: dict, path: str = None) -> str:
+    """Durably append one row to the trend ledger (read + whole-file
+    atomic rewrite via the store's two-phase primitive — the ledger is
+    one small line per bench run, and a SIGKILL mid-append can never
+    leave a torn line for perf-trend to choke on). Returns the path."""
+    import os
+
+    from jepsen_tpu.store import atomic_write_text
+
+    path = path or os.environ.get(
+        "JEPSEN_TPU_TREND_LEDGER", TREND_LEDGER_PATH
+    )
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    existing = ""
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            existing = f.read()
+        if existing and not existing.endswith("\n"):
+            existing += "\n"
+    atomic_write_text(path, existing + json.dumps(row) + "\n")
+    return path
+
+
+def measure_trace_overhead_pct(n: int = 20) -> float:
+    """Tracing-ON cost relative to a sync-floor launch: wall of n
+    probe launches with the flight recorder off vs on, the ON pass
+    carrying the per-launch emission density wgl_bitset actually pays
+    (one span + two launch_stat instants per launch). The published
+    number is what turning the recorder on adds to real launch-bound
+    work — near zero, because emission is appended to a thread-local
+    list while the launch pays a device round trip."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from jepsen_tpu.obs import trace as obs_trace
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.int32)
+    _np.asarray(f(x))  # warm the probe kernel
+
+    def _pass(traced: bool) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            if traced:
+                with obs_trace.span("probe_launch", kind="launch"):
+                    obs_trace.instant("launches", kind="launch_stat")
+                    _np.asarray(f(x))
+                    obs_trace.instant("host_syncs", kind="launch_stat")
+            else:
+                _np.asarray(f(x))
+        return time.perf_counter() - t0
+
+    was_on = obs_trace.TRACER.enabled
+    obs_trace.disable()
+    off = min(_pass(False) for _ in range(2))
+    obs_trace.enable()
+    try:
+        on = min(_pass(True) for _ in range(2))
+    finally:
+        obs_trace.reset()
+        if not was_on:
+            obs_trace.disable()
+    if off <= 0:
+        return 0.0
+    return max(0.0, (on - off) / off * 100.0)
+
+
 # -- CPU baselines -----------------------------------------------------------
 
 
@@ -1469,10 +1577,10 @@ def main() -> None:
         # all five families (incl. D lockorder / E determinism) must
         # be active before the number is publishable.
         _rules_total = analysis.rules_total()
-        if _rules_total < 22:
+        if _rules_total < 23:
             raise SystemExit(
                 f"bench: planelint catalog shrank to {_rules_total} "
-                "rules (< 22): a family is disabled; refusing to "
+                "rules (< 23): a family is disabled; refusing to "
                 "publish"
             )
         print(
@@ -1541,12 +1649,12 @@ def main() -> None:
         return
 
     if "--profile" in sys.argv:
-        # Device-trace the register plane (utils/profiling.trace):
+        # Device-trace the register plane (obs.xla.xla_trace):
         # xla-trace/ lands next to the bench cwd for TensorBoard /
         # Perfetto inspection of the segment chain + batch launches.
-        from jepsen_tpu.utils.profiling import trace
+        from jepsen_tpu.obs.xla import xla_trace
 
-        with trace("xla-trace"):
+        with xla_trace("xla-trace"):
             register_configs, pipeline = bench_register_plane()
     else:
         register_configs, pipeline = bench_register_plane()
@@ -1689,15 +1797,23 @@ def main() -> None:
         f"sync_roundtrip_floor={rt * 1e3:.0f}ms",
         file=sys.stderr,
     )
-    ns = next(c for c in configs if c["name"] == "northstar-100k")
+    # Tracing-ON overhead per launch, published alongside the perf
+    # numbers (and pinned by the trend ledger row below): the flight
+    # recorder must stay cheap enough to leave on in production runs.
+    trace_overhead_pct = round(measure_trace_overhead_pct(), 2)
     print(
-        json.dumps(
-            {
+        f"trace_overhead: {trace_overhead_pct:.2f}% per sync-floor "
+        "launch (recorder ON vs OFF)",
+        file=sys.stderr,
+    )
+    ns = next(c for c in configs if c["name"] == "northstar-100k")
+    record = {
                 "metric": "ops_verified_per_sec",
                 "value": round(total_ops / total_tpu, 1),
                 "unit": "ops/s",
                 "vs_baseline": round(geomean, 3),
                 "vs_python_oracle": round(py_geomean, 3),
+                "trace_overhead_pct": trace_overhead_pct,
                 "baseline": "strongest measured CPU per config "
                             "(see stderr + BENCH_NOTES.md)",
                 "host_cores": os.cpu_count(),
@@ -1826,9 +1942,15 @@ def main() -> None:
                 ),
                 "host_prep": host_prep,
                 "engine_stats": stats,
-            }
-        )
-    )
+    }
+    print(json.dumps(record))
+
+    # Trend ledger: one compact row per run (perf-trend renders the
+    # trajectory and gates regressions). --no-trend opts a run out;
+    # JEPSEN_TPU_TREND_LEDGER redirects the path (tests, scratch runs).
+    if "--no-trend" not in sys.argv:
+        path = append_trend_row(trend_row_from_record(record))
+        print(f"trend ledger: appended to {path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -37,7 +37,7 @@ Module map (reference citations are to /root/reference):
 - cli.py         test/analyze/serve commands (ref: cli.clj)
 - workloads/     generator+client+checker bundles (ref: jepsen/tests/)
 - suites/        etcd, zookeeper, tidb suite shapes (ref: etcd/, tidb/, ...)
-- utils/         pmaps, timeouts, intervals, XLA profiling hooks (ref: util.clj)
+- utils/         pmaps, timeouts, intervals (ref: util.clj)
 """
 
 __version__ = "0.1.0"

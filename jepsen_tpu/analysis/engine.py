@@ -186,6 +186,12 @@ RULES: Dict[str, Tuple[str, str]] = {
         "no obs emission is reachable from jax tracing — trace-time "
         "clock reads bake into the jit cache",
     ),
+    "JT304": (
+        "trace emission in per-device loop",
+        "no span/instant emission inside a per-device or per-member "
+        "loop — ring churn must stay O(1) per plane crossing, not "
+        "O(mesh size); emit the aggregate after the loop",
+    ),
     "JT401": (
         "lock-order cycle",
         "plane locks nest in one global order — a cycle in the "
@@ -229,7 +235,7 @@ META_RULES: Tuple[str, ...] = ("JT000", "JT001")
 FAMILY_RULES: Dict[str, Tuple[str, ...]] = {
     "A": ("JT101", "JT102", "JT103", "JT104", "JT105", "JT106"),
     "B": ("JT201", "JT202", "JT203", "JT204", "JT205"),
-    "C": ("JT301", "JT302", "JT303"),
+    "C": ("JT301", "JT302", "JT303", "JT304"),
     "D": ("JT401", "JT402", "JT403"),
     "E": ("JT501", "JT502", "JT503"),
 }

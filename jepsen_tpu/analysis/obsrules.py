@@ -18,6 +18,13 @@ runtime cannot enforce:
   a traced emission fires at TRACE time, records compile-side wall,
   and its clock read bakes into the jit cache — the timeline would
   show phantom events that never happen on re-execution.
+- JT304 no ``span``/``instant`` emission inside a per-device or
+  per-member loop: ring churn that scales with mesh size turns the
+  recorder from O(1) per plane crossing into O(devices) per crossing
+  — on a pod that is O(hosts x chips) events for ONE logical step,
+  and the ring's drop-on-overflow then evicts the events that
+  mattered. Emit once after the loop with the aggregate
+  (``n=len(devices)``) instead.
 
 Lock-scope inference matches Family B (``with <...lock...>:``), and
 traced-closure inference reuses Family A's ``ModuleInfo`` fixpoint.
@@ -42,6 +49,60 @@ def _is_emit_call(node: ast.Call, tails: Set[str]) -> bool:
     return bool(seg) and seg in tails
 
 
+#: iterables whose loops are per-device / per-member by construction
+#: (``for d in devices:``, ``for m in members:`` ...)
+_MESH_ITER_TAILS = {
+    "devices", "local_devices", "mesh_devices", "members",
+    "member_recs", "procs", "processes", "hosts", "shards",
+}
+#: range()/count bounds that make a loop mesh-sized
+#: (``for i in range(n_devices):`` ...)
+_MESH_BOUND_TAILS = {
+    "n_devices", "n_hosts", "n_members", "n_procs", "n_local_devices",
+    "process_count", "device_count", "local_device_count", "mesh_size",
+}
+#: loop targets that name the per-device / per-member element
+_MESH_TARGET_NAMES = {"device", "dev", "member", "shard"}
+
+
+def _target_names(t: ast.AST) -> Set[str]:
+    if isinstance(t, ast.Name):
+        return {t.id}
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for e in t.elts:
+            out |= _target_names(e)
+        return out
+    return set()
+
+
+def _mesh_iterable(node: ast.AST) -> bool:
+    """Does this loop iterable enumerate mesh members?"""
+    seg = _last_seg(node)
+    if seg in _MESH_ITER_TAILS:
+        return True
+    if isinstance(node, ast.Call):
+        fseg = _last_seg(node.func)
+        if fseg in _MESH_ITER_TAILS:  # jax.devices(), ...
+            return True
+        if fseg in ("enumerate", "sorted", "reversed", "zip", "list"):
+            return any(_mesh_iterable(a) for a in node.args)
+        if fseg == "range":
+            for a in node.args:
+                if _last_seg(a) in _MESH_BOUND_TAILS:
+                    return True
+                if (isinstance(a, ast.Call)
+                        and _last_seg(a.func) in _MESH_BOUND_TAILS):
+                    return True
+    return False
+
+
+def _per_mesh_loop(node: ast.For) -> bool:
+    return _mesh_iterable(node.iter) or bool(
+        _target_names(node.target) & _MESH_TARGET_NAMES
+    )
+
+
 class ObsChecker(ast.NodeVisitor):
     def __init__(self, tree: ast.Module, rel: str):
         self.tree = tree
@@ -61,6 +122,8 @@ class ObsChecker(ast.NodeVisitor):
                         self.with_spans.add(id(item.context_expr))
         #: are we inside a function that only runs under jax tracing?
         self.traced_depth = 0
+        #: depth of enclosing per-device / per-member loops (JT304)
+        self.mesh_loop_depth = 0
 
     @property
     def symbol(self) -> str:
@@ -88,6 +151,9 @@ class ObsChecker(ast.NodeVisitor):
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self.symbols.append(node.name)
         held, self.locks = self.locks, []
+        # a nested def's body runs when CALLED, not per loop
+        # iteration — its mesh-loop context starts fresh
+        in_loop, self.mesh_loop_depth = self.mesh_loop_depth, 0
         traced = (
             node.name in self.info.traced
             or node.name in self.info.jit_impls
@@ -96,6 +162,7 @@ class ObsChecker(ast.NodeVisitor):
         self.traced_depth += 1 if traced else 0
         self.generic_visit(node)
         self.traced_depth -= 1 if traced else 0
+        self.mesh_loop_depth = in_loop
         self.locks = held
         self.symbols.pop()
 
@@ -127,6 +194,19 @@ class ObsChecker(ast.NodeVisitor):
         for _ in acquired:
             self.locks.pop()
 
+    def visit_For(self, node: ast.For) -> None:
+        mesh = _per_mesh_loop(node)
+        self.visit(node.iter)
+        self.visit(node.target)
+        self.mesh_loop_depth += 1 if mesh else 0
+        for stmt in node.body:
+            self.visit(stmt)
+        self.mesh_loop_depth -= 1 if mesh else 0
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    visit_AsyncFor = visit_For
+
     # -- the rules -----------------------------------------------------
 
     def visit_Call(self, node: ast.Call) -> None:
@@ -155,6 +235,15 @@ class ObsChecker(ast.NodeVisitor):
                     "it fires at trace time and its clock read bakes "
                     "into the jit cache; emit from the host-side "
                     "caller instead",
+                )
+            if self.mesh_loop_depth > 0:
+                self.add(
+                    "JT304", node,
+                    "trace emission inside a per-device/per-member "
+                    "loop — ring churn scales with mesh size and "
+                    "drop-on-overflow evicts the events that matter; "
+                    "emit once after the loop with the aggregate "
+                    "(n=len(devices))",
                 )
         self.generic_visit(node)
 

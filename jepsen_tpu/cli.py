@@ -239,21 +239,78 @@ def cmd_analyze(args) -> int:
     records every plane crossing the re-check makes, and exports a
     Perfetto-loadable Chrome-trace JSON to PATH on the way out
     (whatever the verdict — a crashed analysis still leaves its
-    trace). Feed the file to ui.perfetto.dev or `jepsen_tpu
-    trace-summary`."""
+    trace). Inside a pod every member persists its ring into the
+    shared trace dir and process 0 merges ONE clock-aligned trace;
+    single-process runs export directly. --xla-trace DIR additionally
+    wraps the run in a jax.profiler capture (no-op where the profiler
+    is unavailable) so obs spans and the XLA timeline share a run.
+    Feed the file to ui.perfetto.dev or `jepsen_tpu trace-summary`."""
     trace_path = getattr(args, "trace", None)
-    if not trace_path:
+    xla_dir = getattr(args, "xla_trace", None)
+    if not trace_path and not xla_dir:
         return _cmd_analyze(args)
+    from contextlib import ExitStack
+
     from jepsen_tpu import obs
 
-    obs.enable()
-    try:
-        return _cmd_analyze(args)
-    finally:
+    with ExitStack() as stack:
+        if xla_dir:
+            from jepsen_tpu.obs.xla import xla_trace
+
+            stack.enter_context(xla_trace(xla_dir))
+            print(f"xla-trace: capturing to {xla_dir}")
+        if trace_path:
+            obs.enable()
+        try:
+            return _cmd_analyze(args)
+        finally:
+            if trace_path:
+                try:
+                    _export_trace(trace_path)
+                finally:
+                    obs.disable()
+
+
+def _export_trace(trace_path: str) -> None:
+    """Export the live ring to ``trace_path`` — pod-aware.
+
+    Single process: the PR 12 path, one chrome trace straight from the
+    ring. Inside an initialized pod: every member persists its raw
+    ring (plus the init_pod clock record) into the shared trace dir
+    (the JEPSEN_TPU_TRACE_DIR seam, defaulting to trace_path's
+    directory, which all members must share), and process 0 waits for
+    all member files and merges them into ONE clock-aligned Perfetto
+    trace at trace_path."""
+    import os
+
+    from jepsen_tpu import obs
+    from jepsen_tpu.obs import podtrace
+    from jepsen_tpu.pod import topology
+
+    if not topology.is_multiprocess():
         events = obs.spans()
         obs.write_chrome_trace(trace_path, events)
-        obs.disable()
         print(f"trace: {len(events)} events -> {trace_path}")
+        return
+    import jax
+
+    pidx = int(jax.process_index())
+    n_procs = int(jax.process_count())
+    trace_dir = (
+        os.environ.get(podtrace.ENV_TRACE_DIR)
+        or os.path.dirname(os.path.abspath(trace_path))
+    )
+    member_path = podtrace.persist_member_trace(trace_dir)
+    if pidx != 0:
+        print(f"trace: member {pidx} ring -> {member_path}")
+        return
+    merged = podtrace.merge_pod_trace(
+        trace_dir, trace_path, expect_members=n_procs, timeout_s=30.0
+    )
+    print(
+        f"trace: {len(merged['traceEvents'])} events from "
+        f"{n_procs} members -> {trace_path}"
+    )
 
 
 def _cmd_analyze(args) -> int:
@@ -455,6 +512,8 @@ def cmd_trace_summary(args) -> int:
     if evs:
         wall_ms = (max(e["ts"] + e.get("dur", 0) for e in evs)
                    - min(e["ts"] for e in evs)) / 1e3
+    if getattr(args, "by_process", False):
+        return _trace_summary_by_process(obj, evs, wall_ms)
     rows = {}
     for e in evs:
         key = (e.get("cat", "?"), e["name"])
@@ -482,6 +541,101 @@ def cmd_trace_summary(args) -> int:
         print(f"double_buffer_occupancy {sum(regs) / len(regs):.3f}  "
               f"(over {len(regs)} trains)")
     print(f"wall {wall_ms:.3f} ms, {len(evs)} events")
+    return EXIT_VALID
+
+
+def _trace_summary_by_process(obj, evs, wall_ms: float) -> int:
+    """Per-member attribution from a merged pod trace: wall and span
+    totals by Perfetto pid, named from the trace's own process_name
+    metadata rows — everything comes from the file, no live pod
+    needed. Also discloses the recorded clock skew bound so readers
+    know the alignment error bar on cross-member comparisons."""
+    names = {}
+    for e in obj["traceEvents"]:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            names[e.get("pid", 1)] = str(
+                (e.get("args") or {}).get("name", "?")
+            )
+    rows = {}
+    for e in evs:
+        pid = e.get("pid", 1)
+        cnt, tot = rows.get(pid, (0, 0.0))
+        rows[pid] = (cnt + 1, tot + e.get("dur", 0) / 1e3)
+    print(f"{'process':<20} {'pid':>4} {'events':>8} {'total_ms':>10} "
+          f"{'%wall':>6}")
+    for pid in sorted(rows):
+        cnt, tot = rows[pid]
+        pct = 100.0 * tot / wall_ms if wall_ms else 0.0
+        print(f"{names.get(pid, '?'):<20} {pid:>4} {cnt:>8} "
+              f"{tot:>10.3f} {pct:>6.1f}")
+    meta = obj.get("metadata") or {}
+    skew = meta.get("clock_skew_bound_ns")
+    if skew is not None:
+        print(f"clock_skew_bound {int(skew) / 1e3:.1f} us "
+              f"({len(meta.get('members', []))} members)")
+    print(f"wall {wall_ms:.3f} ms, {len(evs)} events, "
+          f"{len(rows)} process(es)")
+    return EXIT_VALID
+
+
+def cmd_perf_trend(args) -> int:
+    """Render the bench trend ledger (bench_runs/trend.jsonl — one
+    compact row per bench run) and gate on regressions: exit 1 when
+    the latest row's vs_baseline geomean dropped more than
+    --max-regression (fractional) below the previous row's, exit 2
+    when there is no ledger to judge. The perf story stays observable
+    ACROSS runs, not just within one."""
+    import json
+    import os
+
+    path = args.ledger
+    if not os.path.exists(path):
+        print(f"perf-trend: no trend ledger at {path}")
+        return EXIT_UNKNOWN
+    rows = []
+    with open(path, encoding="utf-8") as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln:
+                rows.append(json.loads(ln))
+    if not rows:
+        print(f"perf-trend: empty trend ledger at {path}")
+        return EXIT_UNKNOWN
+
+    def _num(row, key):
+        v = row.get(key)
+        return f"{v:.3f}" if isinstance(v, (int, float)) else "-"
+
+    print(f"{'ts':<20} {'vs_base':>8} {'vs_py':>10} {'syncs':>6} "
+          f"{'floor_ms':>9} {'occup':>6} {'trace_ov%':>9} "
+          f"{'ops/s':>10}")
+    for r in rows:
+        ts = str(r.get("ts", "?"))[:19]
+        print(f"{ts:<20} {_num(r, 'vs_baseline'):>8} "
+              f"{_num(r, 'vs_python_oracle'):>10} "
+              f"{_num(r, 'syncs_per_check'):>6} "
+              f"{_num(r, 'sync_floor_ms'):>9} "
+              f"{_num(r, 'double_buffer_occupancy'):>6} "
+              f"{_num(r, 'trace_overhead_pct'):>9} "
+              f"{_num(r, 'ops_per_sec'):>10}")
+    if len(rows) < 2:
+        print(f"perf-trend: {len(rows)} row(s); nothing to compare yet")
+        return EXIT_VALID
+    prev = rows[-2].get("vs_baseline")
+    cur = rows[-1].get("vs_baseline")
+    if not isinstance(prev, (int, float)) or not isinstance(
+            cur, (int, float)) or prev <= 0:
+        print("perf-trend: vs_baseline missing on the last two rows; "
+              "no gate applied")
+        return EXIT_VALID
+    drop = (prev - cur) / prev
+    if drop > args.max_regression:
+        print(f"perf-trend: REGRESSION: vs_baseline {prev:.3f} -> "
+              f"{cur:.3f} ({drop * 100:.1f}% drop > "
+              f"{args.max_regression * 100:.1f}% budget)")
+        return EXIT_INVALID
+    print(f"perf-trend: ok: vs_baseline {prev:.3f} -> {cur:.3f} "
+          f"({len(rows)} runs on record)")
     return EXIT_VALID
 
 
@@ -594,6 +748,10 @@ def cmd_daemon(args) -> int:
 
     _reset_engine_state()
     _apply_mesh_args(args)
+    if args.trace:
+        from jepsen_tpu import obs
+
+        obs.enable()
     daemon = CheckerDaemon(
         root=args.store,
         host=args.host,
@@ -606,6 +764,8 @@ def cmd_daemon(args) -> int:
         coalesce_hold_s=args.coalesce_hold,
         launch_deadline_s=args.launch_deadline,
         drain_s=args.drain_seconds,
+        audit_path=args.audit_path,
+        audit_max_bytes=args.audit_max_mb << 20,
     )
     handle = install_signal_drain(daemon.drain)
     print(f"checker daemon serving on {daemon.url} "
@@ -716,7 +876,12 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--trace", default=None, metavar="PATH",
                    help="record every plane crossing with the flight "
                         "recorder and export a Perfetto-loadable "
-                        "Chrome-trace JSON to PATH")
+                        "Chrome-trace JSON to PATH (pod runs merge "
+                        "all members into one aligned trace)")
+    a.add_argument("--xla-trace", default=None, metavar="DIR",
+                   help="also capture a jax.profiler XLA trace into "
+                        "DIR (no-op where the profiler is "
+                        "unavailable, e.g. plain CPU meshes)")
     a.set_defaults(fn=cmd_analyze)
 
     ts = sub.add_parser(
@@ -725,7 +890,27 @@ def build_parser() -> argparse.ArgumentParser:
              "from an `analyze --trace` Chrome-trace file",
     )
     ts.add_argument("path", help="Chrome-trace JSON file")
+    ts.add_argument("--by-process", action="store_true",
+                    help="attribute wall per pod member (merged pod "
+                         "traces; reads process_name metadata rows "
+                         "and the recorded clock skew bound)")
     ts.set_defaults(fn=cmd_trace_summary)
+
+    pt = sub.add_parser(
+        "perf-trend",
+        help="render the bench trend ledger and gate on geomean "
+             "regressions vs the previous run",
+    )
+    pt.add_argument("--ledger", default="bench_runs/trend.jsonl",
+                    metavar="PATH",
+                    help="trend ledger written by bench.py "
+                         "(default: bench_runs/trend.jsonl)")
+    pt.add_argument("--max-regression", type=float, default=0.10,
+                    metavar="FRACTION",
+                    help="fail (exit 1) when vs_baseline drops more "
+                         "than this fraction below the previous row "
+                         "(default 0.10)")
+    pt.set_defaults(fn=cmd_perf_trend)
 
     ln = sub.add_parser(
         "lint",
@@ -785,6 +970,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-launch deadline inherited by the plane")
     d.add_argument("--drain-seconds", type=float, default=10.0,
                    help="SIGTERM drain budget for in-flight checks")
+    d.add_argument("--audit-path", default=None, metavar="PATH",
+                   help="request audit log (JSONL; default "
+                        "<store>/.service/audit.jsonl)")
+    d.add_argument("--audit-max-mb", type=int, default=4,
+                   help="rotate the audit log past this many MiB")
+    d.add_argument("--trace", action="store_true",
+                   help="enable the flight recorder for the daemon's "
+                        "life; GET /trace drains the ring")
     d.set_defaults(fn=cmd_daemon)
     return p
 

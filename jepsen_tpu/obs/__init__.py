@@ -12,16 +12,24 @@ industry-standard artifacts:
   (``span(...)`` context manager + ``instant(...)`` events, disabled
   by default — the off path is one attribute check, safe in hot paths)
 - ``obs.export``: Chrome-trace/Perfetto JSON + JSONL sinks
+- ``obs.podtrace``: pod-wide aggregation (PR 15) — per-member ring
+  persistence plus ``merge_pod_trace``, which rebases every member
+  onto one clock-aligned timeline using the ``init_pod`` handshake's
+  offsets and emits a single multi-process Perfetto trace
 - ``obs.prom``: Prometheus text exposition folding in every ``*_STATS``
-  surface plus trace-derived latency histograms
+  surface plus trace-derived latency histograms and per-tenant /
+  per-device labeled gauge families
+- ``obs.xla``: ``xla_trace(dir)`` jax.profiler capture (no-op on
+  meshes without a profiler), unified here from utils/profiling.py
 - ``obs.snapshot``: the ONE consolidated ``engine_snapshot()`` behind
   ``cli._engine_stats``, the daemon's ``/stats``, and the dryrun
   metric line (imported lazily — it pulls the jax-backed checker
   modules, which this package root must not)
 
-planelint Family C (JT301-303) enforces the emission discipline:
+planelint Family C (JT301-304) enforces the emission discipline:
 spans close via context manager, nothing emits under a plane lock,
-and no obs call is reachable from jit-traced code.
+no obs call is reachable from jit-traced code, and nothing emits
+inside a per-device/per-member fan-out loop.
 """
 
 from jepsen_tpu.obs.trace import (  # noqa: F401
@@ -39,4 +47,9 @@ from jepsen_tpu.obs.export import (  # noqa: F401
     validate_chrome_trace,
     write_chrome_trace,
     write_jsonl,
+)
+from jepsen_tpu.obs.podtrace import (  # noqa: F401
+    ENV_TRACE_DIR,
+    merge_pod_trace,
+    persist_member_trace,
 )

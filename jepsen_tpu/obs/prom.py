@@ -45,6 +45,66 @@ def _flatten(prefix: str, obj: dict, out: List[Tuple[str, float]]) -> None:
         # strings and None carry no gauge value
 
 
+def _escape_label(value: str) -> str:
+    """Escape a label VALUE per the exposition format: backslash,
+    double-quote, and newline are the three characters that corrupt
+    the text format; everything else (including UTF-8 tenant names)
+    passes through verbatim."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _tenant_lines(tenants: Dict[str, dict], lines: List[str]) -> None:
+    """Per-tenant labeled gauge families from TenantLedger rows:
+    ``jepsen_tpu_tenant_<counter>{tenant="..."}``. One HELP/TYPE per
+    family, every tenant a sample under it — the exposition-format
+    shape scrapers require (a family's samples must be contiguous)."""
+    counters: List[str] = sorted(
+        {k for row in tenants.values()
+         for k, v in row.items() if isinstance(v, (bool, int, float))}
+    )
+    for counter in counters:
+        name = f"jepsen_tpu_tenant_{_sanitize(counter)}"
+        lines.append(f"# HELP {name} Per-tenant ledger counter "
+                     f"{counter}.")
+        lines.append(f"# TYPE {name} gauge")
+        for tenant in sorted(tenants):
+            v = tenants[tenant].get(counter)
+            if isinstance(v, bool):
+                v = 1.0 if v else 0.0
+            elif not isinstance(v, (int, float)):
+                continue
+            lines.append(
+                f'{name}{{tenant="{_escape_label(tenant)}"}} {v:g}'
+            )
+
+
+def _quarantine_lines(snapshot: dict, lines: List[str]) -> None:
+    """Labeled per-device / per-host-domain quarantine gauges from the
+    resilience ledgers (the unlabeled gauges only carry the counts)."""
+    res = snapshot.get("resilience")
+    if not isinstance(res, dict):
+        return
+    for key, name, label in (
+        ("quarantined_devices", "jepsen_tpu_device_quarantined",
+         "device"),
+        ("quarantined_hosts", "jepsen_tpu_host_domain_quarantined",
+         "host"),
+    ):
+        entries = res.get(key)
+        if not isinstance(entries, (list, tuple)) or not entries:
+            continue
+        lines.append(f"# HELP {name} Quarantined {label} (1 = out of "
+                     "the mesh until probation passes).")
+        lines.append(f"# TYPE {name} gauge")
+        for entry in sorted(str(e) for e in entries):
+            lines.append(f'{name}{{{label}="{_escape_label(entry)}"}} 1')
+
+
 def _histograms(events: List[dict]) -> Dict[str, Tuple[List[int], float, int]]:
     """Per-kind duration histograms from complete events: kind ->
     (cumulative bucket counts, sum_seconds, count)."""
@@ -66,10 +126,12 @@ def _histograms(events: List[dict]) -> Dict[str, Tuple[List[int], float, int]]:
 
 
 def prometheus_text(snapshot: Optional[dict] = None,
-                    events: Optional[List[dict]] = None) -> str:
+                    events: Optional[List[dict]] = None,
+                    tenants: Optional[Dict[str, dict]] = None) -> str:
     """Render the full exposition. Pass ``snapshot``/``events`` to
     render a captured state (tests, trace-summary); default reads the
-    live engine."""
+    live engine. ``tenants`` (TenantLedger.snapshot() rows) adds the
+    per-tenant labeled gauge families the daemon serves."""
     if snapshot is None:
         from jepsen_tpu.obs.snapshot import engine_snapshot
 
@@ -92,6 +154,10 @@ def prometheus_text(snapshot: Optional[dict] = None,
         lines.append(f"# TYPE {name} gauge")
         # %g keeps integers integral and floats short
         lines.append(f"{name} {value:g}")
+
+    if tenants:
+        _tenant_lines(tenants, lines)
+    _quarantine_lines(snapshot, lines)
 
     hname = "jepsen_tpu_span_duration_seconds"
     hists = _histograms(events)

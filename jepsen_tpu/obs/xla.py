@@ -1,0 +1,39 @@
+"""XLA device tracing, unified into the obs surface.
+
+The reference's observability planes are the op log, the control audit
+log, and post-hoc graphs (SURVEY.md §5); the accelerator-resident
+checker adds XLA/TPU execution traces. ``xla_trace(dir)`` wraps any
+checking code in a jax profiler capture viewable in TensorBoard /
+Perfetto — `cli analyze --xla-trace DIR` and `bench --profile` both
+ride it, so the flight-recorder spans and the XLA timeline share one
+run dir. (This absorbed utils/profiling.py: one tracing stack, not
+two.)
+
+jax is imported lazily so ``jepsen_tpu.obs`` itself stays stdlib-only.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def xla_trace(log_dir: str):
+    """Capture a device trace for the enclosed block (falls back to a
+    no-op when the profiler can't start, e.g. on CPU test meshes)."""
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception:
+        pass
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass

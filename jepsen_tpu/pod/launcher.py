@@ -27,6 +27,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+from jepsen_tpu.obs import podtrace
 from jepsen_tpu.pod import topology
 
 #: prepended to every child script: join the pod before user code.
@@ -108,17 +109,24 @@ def launch_pod(
     python: Optional[str] = None,
     extra_env: Optional[Dict[str, str]] = None,
     cwd: Optional[str] = None,
+    trace_dir: Optional[str] = None,
 ) -> List[PodProc]:
     """Spawn an ``n_procs``-process CPU pod on localhost running
     ``script`` (a Python source string) in every member, and wait for
     all of them. Pod collectives are barriers: one hung member wedges
     the rest, so blowing ``timeout_s`` kills the WHOLE pod (survivors
     would never finish) and the dead members report returncode=None
-    or the kill signal."""
+    or the kill signal.
+
+    ``trace_dir`` propagates the tracing env seam
+    (``JEPSEN_TPU_TRACE_DIR``) to every member so each persists its
+    flight-recorder ring there for ``podtrace.merge_pod_trace``."""
     coordinator = f"127.0.0.1:{free_port()}"
     procs: List[subprocess.Popen] = []
     for pid in range(n_procs):
         env = pod_env(coordinator, n_procs, pid, n_local_devices)
+        if trace_dir is not None:
+            env[podtrace.ENV_TRACE_DIR] = trace_dir
         if extra_env:
             env.update(extra_env)
         procs.append(

@@ -25,6 +25,7 @@ from __future__ import annotations
 import os
 import sys
 import threading
+import time
 from dataclasses import dataclass
 from typing import Optional
 
@@ -68,6 +69,7 @@ POD_STATS = {
     "coordinator": None,
     "n_hosts_configured": 1,
     "process_id_configured": 0,
+    "clock": None,
 }
 
 _pod_stats_lock = threading.Lock()
@@ -133,15 +135,62 @@ def init_pod(config: Optional[PodConfig] = None,
                 process_id=cfg.process_id,
                 initialization_timeout=int(timeout_s),
             )
+        clock = _clock_handshake(cfg.process_id)
         with _pod_stats_lock:
             POD_STATS["initialized"] = True
             POD_STATS["coordinator"] = cfg.coordinator
             POD_STATS["n_hosts_configured"] = cfg.num_processes
             POD_STATS["process_id_configured"] = cfg.process_id
+            POD_STATS["clock"] = clock
     finally:
         with _init_lock:
             _init_pending[0] = False
     return topology_snapshot()
+
+
+def _clock_handshake(process_id: int) -> Optional[dict]:
+    """Exchange perf_counter_ns anchors right after the coordinator
+    barrier; runs in init_pod's lock-free region (it is a collective).
+
+    Every member allgathers its monotonic anchor, taken as close to the
+    barrier exit as possible. The anchor travels as an (hi, lo) int32
+    pair — jax without x64 truncates int64 payloads, and perf_counter_ns
+    values (~1e13) do not survive that. ``offset_ns`` rebases this
+    member onto member 0's clock domain; ``skew_bound_ns`` is this
+    member's own allgather window (enter-to-exit), an upper bound on
+    how misaligned the anchors can be. Returns None when the transport
+    can't run the collective (e.g. a jaxlib without gloo) — tracing
+    then degrades to unaligned per-member timelines, not a crash.
+    """
+    try:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        t_enter = time.perf_counter_ns()
+        hi, lo = divmod(t_enter, 1 << 31)
+        anchors = multihost_utils.process_allgather(
+            np.asarray([hi, lo], dtype=np.int32)
+        )
+        t_exit = time.perf_counter_ns()
+        anchors_ns = [
+            int(a[0]) * (1 << 31) + int(a[1]) for a in np.asarray(anchors)
+        ]
+        return {
+            "anchor_ns": t_enter,
+            "offset_ns": anchors_ns[process_id] - anchors_ns[0],
+            "skew_bound_ns": t_exit - t_enter,
+            "anchors_ns": anchors_ns,
+        }
+    except Exception:  # pragma: no cover - transport-dependent
+        return None
+
+
+def pod_clock() -> Optional[dict]:
+    """The clock-alignment record from init_pod's handshake (None in a
+    single process or when the handshake couldn't run)."""
+    with _pod_stats_lock:
+        clk = POD_STATS["clock"]
+        return dict(clk) if clk else None
 
 
 def topology_snapshot() -> dict:

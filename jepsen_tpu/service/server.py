@@ -23,7 +23,20 @@ Endpoints::
                    definite verdict
     GET  /stats    dispatch + launch + resilience + checkpoint +
                    tenant-ledger + admission snapshots
+    GET  /metrics  Prometheus text exposition, including per-tenant
+                   labeled gauge families reconciled from the live
+                   TenantLedger rows
+    GET  /trace    drain the live flight-recorder ring as validated
+                   Chrome-trace JSON (empty trace when the recorder
+                   is disabled); each GET returns the events since
+                   the previous one
     GET  /healthz  liveness + drain state
+
+Every request — GET or POST, admitted or shed — lands exactly once in
+the structured JSONL audit log (``service/audit.py``): tenant,
+admission verdict, HTTP status, wall seconds, and the device launches
+attributed to the request window. Size-rotated, fsync'd before the
+response leaves.
 
 HTTP status mapping (the analyze exit-code contract, served):
 
@@ -75,6 +88,7 @@ from jepsen_tpu.service.admission import (
     AdmissionControl,
     AdmissionError,
 )
+from jepsen_tpu.service.audit import AuditLog, default_audit_path
 from jepsen_tpu.service.tenants import DEFAULT_TENANT, TenantLedger
 from jepsen_tpu.store import Store, op_from_json
 
@@ -151,6 +165,8 @@ class CheckerDaemon:
         coalesce_hold_s: float = DEFAULT_COALESCE_HOLD_S,
         launch_deadline_s: Optional[float] = None,
         drain_s: float = 10.0,
+        audit_path: Optional[str] = None,
+        audit_max_bytes: int = 4 * 1024 * 1024,
     ):
         if interpret is None:
             interpret = os.environ.get(
@@ -162,6 +178,12 @@ class CheckerDaemon:
         self.coalesce_hold_s = max(float(coalesce_hold_s), 0.0)
         self.drain_s = drain_s
         self.store = Store(root)
+        # The control audit plane: one record per request, durable
+        # before the response leaves (service/audit.py).
+        self.audit = AuditLog(
+            audit_path or default_audit_path(root),
+            max_bytes=audit_max_bytes,
+        )
         self.ledger = TenantLedger(
             strict_default=strict_default,
             quarantine_after=tenant_quarantine_after,
@@ -235,6 +257,7 @@ class CheckerDaemon:
             self.httpd.server_close()
         except OSError:
             pass
+        self.audit.close()
 
     def __enter__(self) -> "CheckerDaemon":
         return self
@@ -461,6 +484,20 @@ class CheckerDaemon:
         return 200, out
 
 
+def _launch_count() -> int:
+    """Live device-launch counter, for attributing launches to a
+    request window in the audit log. Under concurrent requests the
+    windows overlap, so attribution is an upper bound per record —
+    the audit plane documents cost, the ledger owns exact accounting."""
+    from jepsen_tpu.checker.wgl_bitset import launch_stats_snapshot
+
+    return int(launch_stats_snapshot()["launches"])
+
+
+def _json_body(code: int, obj: dict) -> tuple:
+    return code, json.dumps(obj).encode(), "application/json"
+
+
 class _Handler(BaseHTTPRequestHandler):
     daemon_obj: CheckerDaemon  # bound by CheckerDaemon.__init__
     protocol_version = "HTTP/1.1"
@@ -480,67 +517,129 @@ class _Handler(BaseHTTPRequestHandler):
         t = (self.headers.get("X-Tenant") or "").strip()
         return t or DEFAULT_TENANT
 
+    def _send_text(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):  # noqa: N802 (stdlib API)
         d = self.daemon_obj
+        tenant = self._tenant()
+        t0 = time.perf_counter()
+        l0 = _launch_count()
+        code, body, ctype = self._route_get(d)
+        # GET endpoints are unmetered (no admission gate), but they
+        # still appear exactly once in the control audit plane —
+        # durable before the response leaves.
+        d.audit.record(
+            tenant=tenant, path=self.path, admission="open",
+            status=code, wall_s=time.perf_counter() - t0,
+            launches=_launch_count() - l0,
+        )
+        self._send_text(code, body, ctype)
+
+    def _route_get(self, d: CheckerDaemon) -> tuple:
+        """(status, body bytes, content type) for one GET."""
         if self.path == "/healthz":
-            self._send_json(200, {
+            return _json_body(200, {
                 "ok": True,
                 "draining": d.admission.draining,
                 "uptime_s": time.time() - d.started_at,
             })
-            return
         if self.path == "/stats":
-            self._send_json(200, _jsonable(d.stats()))
-            return
+            return _json_body(200, _jsonable(d.stats()))
         if self.path == "/metrics":
             from jepsen_tpu.obs.prom import prometheus_text
 
-            body = prometheus_text().encode()
-            self.send_response(200)
-            self.send_header(
-                "Content-Type", "text/plain; version=0.0.4"
+            # tenants= adds the per-tenant labeled gauge families —
+            # the exposition reconciles exactly with the live ledger
+            body = prometheus_text(
+                tenants=d.ledger.snapshot()
+            ).encode()
+            return 200, body, "text/plain; version=0.0.4"
+        if self.path == "/trace":
+            from jepsen_tpu.obs.export import (
+                chrome_trace,
+                validate_chrome_trace,
             )
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-            return
-        self._send_json(404, {"error": "not-found"})
+
+            # Drain the live ring: lower everything recorded so far,
+            # validate against the golden Chrome-trace schema (an
+            # export Perfetto can't load is a 500, not a silent
+            # download), then reset the ring so the next GET returns
+            # only what happened since. Events emitted between the
+            # snapshot and the reset are dropped — the ring already
+            # has drop-on-overflow semantics, and telemetry loss here
+            # is bounded by the handler's own wall time.
+            events = obs_trace.TRACER.spans()
+            obj = chrome_trace(events)
+            errors = validate_chrome_trace(obj)
+            if errors:
+                return _json_body(500, {
+                    "error": "trace-invalid", "detail": errors[:5],
+                })
+            obs_trace.TRACER.reset()
+            obj["metadata"] = {
+                "events": len(events),
+                "enabled": obs_trace.TRACER.enabled,
+            }
+            return _json_body(200, obj)
+        return _json_body(404, {"error": "not-found"})
 
     def do_POST(self):  # noqa: N802 (stdlib API)
-        if self.path not in ("/check", "/check/stream"):
-            self._send_json(404, {"error": "not-found"})
-            return
         d = self.daemon_obj
         tenant = self._tenant()
-        cl = self.headers.get("Content-Length")
-        # per-request root span: tenant + path up front, admission
-        # verdict and response status attached as they're decided
-        with obs_trace.span("request", kind="service", tenant=tenant,
-                            path=self.path) as sp:
-            try:
-                d.admission.check_payload(
-                    tenant, int(cl) if cl is not None else None
-                )
-                token = d.admission.admit(tenant)
-            except AdmissionError as e:
-                sp.set(admission=e.reason, status=e.status)
-                self._send_json(e.status, {
-                    "error": e.reason, "detail": e.detail,
-                })
+        t0 = time.perf_counter()
+        l0 = _launch_count()
+        admission = "rejected"
+        status = 500
+        obj: dict = {"error": "internal"}
+        try:
+            if self.path not in ("/check", "/check/stream"):
+                admission, status = "open", 404
+                obj = {"error": "not-found"}
                 return
-            sp.set(admission="admitted")
-            try:
-                body = self.rfile.read(int(cl))
-                if self.path == "/check/stream":
-                    status, obj = d.handle_stream(tenant, body)
-                else:
-                    status, obj = d.handle_check(tenant, body)
-            except Exception as e:  # noqa: BLE001 - last-resort envelope
-                log.exception("unhandled service error")
-                status, obj = 500, {
-                    "error": "internal", "detail": str(e),
-                }
-            finally:
-                token.release()
-            sp.set(status=status)
+            cl = self.headers.get("Content-Length")
+            # per-request root span: tenant + path up front, admission
+            # verdict and response status attached as they're decided
+            with obs_trace.span("request", kind="service",
+                                tenant=tenant, path=self.path) as sp:
+                try:
+                    d.admission.check_payload(
+                        tenant, int(cl) if cl is not None else None
+                    )
+                    token = d.admission.admit(tenant)
+                except AdmissionError as e:
+                    admission, status = e.reason, e.status
+                    sp.set(admission=e.reason, status=e.status)
+                    obj = {"error": e.reason, "detail": e.detail}
+                    return
+                admission = "admitted"
+                sp.set(admission="admitted")
+                try:
+                    body = self.rfile.read(int(cl))
+                    if self.path == "/check/stream":
+                        status, obj = d.handle_stream(tenant, body)
+                    else:
+                        status, obj = d.handle_check(tenant, body)
+                except Exception as e:  # noqa: BLE001 - last resort
+                    log.exception("unhandled service error")
+                    status, obj = 500, {
+                        "error": "internal", "detail": str(e),
+                    }
+                finally:
+                    token.release()
+                sp.set(status=status)
+        finally:
+            # Exactly one audit record per request, whatever path the
+            # handler took (shed at the door, crashed, or answered) —
+            # durable BEFORE the response leaves, so a reader who saw
+            # the response is guaranteed to find the record.
+            d.audit.record(
+                tenant=tenant, path=self.path, admission=admission,
+                status=status, wall_s=time.perf_counter() - t0,
+                launches=_launch_count() - l0,
+            )
             self._send_json(status, obj)

@@ -311,3 +311,260 @@ def test_cli_trace_summary_rejects_bad_schema(tmp_path, capsys):
     p.write_text(json.dumps({"traceEvents": [{"ph": "Z"}]}))
     assert main(["trace-summary", str(p)]) == EXIT_UNKNOWN
     assert "schema" in capsys.readouterr().out
+
+
+# -- pod-wide flight recorder (obs/podtrace) ---------------------------
+
+
+def _member_events(base_ns, tid=1, tname="MainThread"):
+    """A tiny synthetic member ring: one span + one instant, raw ns."""
+    return [
+        {"name": "check", "kind": "service", "ph": "X",
+         "ts": base_ns, "dur": 5_000_000, "tid": tid, "tname": tname,
+         "args": {"tenant": "t0"}},
+        {"name": "launches", "kind": "launch_stat", "ph": "i",
+         "ts": base_ns + 1_000_000, "dur": 0, "tid": tid,
+         "tname": tname, "args": {"n": 1}},
+    ]
+
+
+def test_podtrace_persist_load_roundtrip(tmp_path):
+    from jepsen_tpu.obs import podtrace
+
+    path = podtrace.persist_member_trace(
+        str(tmp_path), process_index=1, n_hosts=2,
+        events=_member_events(10_000),
+        clock={"offset_ns": 500, "skew_bound_ns": 50},
+    )
+    assert path.endswith("member-001.trace.json")
+    obj = podtrace.load_member_trace(path)
+    assert obj["schema"] == podtrace.SCHEMA_VERSION
+    assert obj["process_index"] == 1 and obj["n_hosts"] == 2
+    assert len(obj["events"]) == 2
+
+
+def test_podtrace_load_rejects_wrong_schema(tmp_path):
+    from jepsen_tpu.obs import podtrace
+
+    p = tmp_path / "member-000.trace.json"
+    p.write_text(json.dumps({"schema": 999, "events": []}))
+    with pytest.raises(ValueError, match="schema"):
+        podtrace.load_member_trace(str(p))
+
+
+def test_podtrace_merge_rebases_onto_member0_clock(tmp_path):
+    from jepsen_tpu.obs import podtrace
+
+    # Member 1's clock reads 1 ms ahead of member 0's: the SAME
+    # physical instant carries different raw timestamps, and the
+    # handshake's recorded offset brings them back together.
+    podtrace.persist_member_trace(
+        str(tmp_path), process_index=0, n_hosts=2,
+        events=_member_events(1_000_000),
+        clock={"offset_ns": 0, "skew_bound_ns": 20_000},
+    )
+    podtrace.persist_member_trace(
+        str(tmp_path), process_index=1, n_hosts=2,
+        events=_member_events(1_000_000 + 1_000_000),
+        clock={"offset_ns": 1_000_000, "skew_bound_ns": 40_000},
+    )
+    out = str(tmp_path / "pod_trace.json")
+    merged = podtrace.merge_pod_trace(
+        str(tmp_path), out, expect_members=2
+    )
+    assert validate_chrome_trace(merged) == []
+    # one Perfetto process per member, named and sort-indexed
+    names = {e["pid"]: e["args"]["name"]
+             for e in merged["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {1: "pod-member-0", 2: "pod-member-1"}
+    sorts = {e["pid"]: e["args"]["sort_index"]
+             for e in merged["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_sort_index"}
+    assert sorts == {1: 0, 2: 1}
+    # rebased: the same physical instant lands at the same merged ts
+    spans = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    by_pid = {e["pid"]: e["ts"] for e in spans}
+    assert by_pid[1] == by_pid[2] == 0.0
+    # skew bound disclosed: the worst member window
+    meta = merged["metadata"]
+    assert meta["clock_skew_bound_ns"] == 40_000
+    assert [m["process_index"] for m in meta["members"]] == [0, 1]
+    assert all(m["events"] == 2 for m in meta["members"])
+    # the merged trace persisted atomically to out_path
+    disk = json.loads(open(out).read())
+    assert disk == merged
+
+
+def test_podtrace_merge_times_out_loudly_on_missing_member(tmp_path):
+    from jepsen_tpu.obs import podtrace
+
+    podtrace.persist_member_trace(
+        str(tmp_path), process_index=0, n_hosts=2,
+        events=_member_events(0),
+        clock={"offset_ns": 0, "skew_bound_ns": 0},
+    )
+    with pytest.raises(RuntimeError, match="expected 2"):
+        podtrace.merge_pod_trace(
+            str(tmp_path), expect_members=2, timeout_s=0.2
+        )
+
+
+def test_podtrace_merge_without_clock_degrades_unaligned(tmp_path):
+    # A member whose handshake couldn't run (clock None) still merges
+    # — unaligned (offset 0), never a crash.
+    from jepsen_tpu.obs import podtrace
+
+    p = tmp_path / "member-000.trace.json"
+    p.write_text(json.dumps({
+        "schema": podtrace.SCHEMA_VERSION, "process_index": 0,
+        "n_hosts": 1, "clock": None, "events": _member_events(5_000),
+    }))
+    merged = podtrace.merge_pod_trace(str(tmp_path))
+    assert validate_chrome_trace(merged) == []
+    assert merged["metadata"]["members"][0]["offset_ns"] == 0
+
+
+def test_cli_trace_summary_by_process(tmp_path, capsys):
+    """Per-member attribution from the merged file alone — no live
+    pod needed."""
+    from jepsen_tpu.cli import EXIT_VALID, main
+    from jepsen_tpu.obs import podtrace
+
+    for pidx in (0, 1):
+        podtrace.persist_member_trace(
+            str(tmp_path), process_index=pidx, n_hosts=2,
+            events=_member_events(1_000_000 * (pidx + 1)),
+            clock={"offset_ns": 1_000_000 * pidx,
+                   "skew_bound_ns": 30_000},
+        )
+    out = tmp_path / "pod_trace.json"
+    podtrace.merge_pod_trace(str(tmp_path), str(out),
+                             expect_members=2)
+    assert main(["trace-summary", str(out), "--by-process"]) \
+        == EXIT_VALID
+    txt = capsys.readouterr().out
+    assert "pod-member-0" in txt and "pod-member-1" in txt
+    assert "clock_skew_bound" in txt and "2 members" in txt
+    assert "2 process(es)" in txt
+
+
+# -- xla trace unification (obs/xla absorbed utils/profiling) ----------
+
+
+def test_xla_trace_contextmanager_never_raises(tmp_path):
+    from jepsen_tpu.obs.xla import xla_trace
+
+    with xla_trace(str(tmp_path / "xla")):
+        x = 1 + 1
+    assert x == 2
+
+
+def test_utils_profiling_is_gone():
+    # one tracing stack, not two: the old duplicate module must not
+    # quietly come back
+    import importlib
+
+    with pytest.raises(ModuleNotFoundError):
+        importlib.import_module("jepsen_tpu.utils.profiling")
+
+
+# -- bench trend ledger / cli perf-trend -------------------------------
+
+
+def test_cli_perf_trend_exit_code_contract(tmp_path, capsys):
+    from jepsen_tpu.cli import (
+        EXIT_INVALID,
+        EXIT_UNKNOWN,
+        EXIT_VALID,
+        main,
+    )
+
+    ledger = tmp_path / "trend.jsonl"
+    # no ledger -> unknown (exit 2)
+    assert main(["perf-trend", "--ledger", str(ledger)]) \
+        == EXIT_UNKNOWN
+    capsys.readouterr()
+
+    row = {"ts": "2026-08-06T00:00:00+00:00", "ops_per_sec": 1000.0,
+           "vs_baseline": 2.0, "vs_python_oracle": 30.0,
+           "syncs_per_check": 1.0, "sync_floor_ms": 94.0,
+           "double_buffer_occupancy": 2.0, "trace_overhead_pct": 0.4,
+           "smoke": False}
+    ledger.write_text(json.dumps(row) + "\n")
+    assert main(["perf-trend", "--ledger", str(ledger)]) == EXIT_VALID
+    assert "nothing to compare" in capsys.readouterr().out
+
+    # two consecutive runs render both rows and pass the gate
+    row2 = dict(row, ts="2026-08-07T00:00:00+00:00", vs_baseline=2.1)
+    ledger.write_text(
+        json.dumps(row) + "\n" + json.dumps(row2) + "\n"
+    )
+    assert main(["perf-trend", "--ledger", str(ledger)]) == EXIT_VALID
+    out = capsys.readouterr().out
+    assert "2026-08-06" in out and "2026-08-07" in out
+    assert "ok" in out
+
+    # synthetic regressed run: > 10% vs_baseline drop trips exit 1
+    row3 = dict(row, ts="2026-08-08T00:00:00+00:00", vs_baseline=1.0)
+    ledger.write_text(
+        "".join(json.dumps(r) + "\n" for r in (row, row2, row3))
+    )
+    assert main(["perf-trend", "--ledger", str(ledger)]) \
+        == EXIT_INVALID
+    assert "REGRESSION" in capsys.readouterr().out
+
+    # a tightened budget flags what the default forgives
+    ledger.write_text(
+        json.dumps(row2) + "\n" + json.dumps(row) + "\n"
+    )  # 2.1 -> 2.0 is a ~4.8% drop
+    assert main(["perf-trend", "--ledger", str(ledger)]) == EXIT_VALID
+    capsys.readouterr()
+    assert main([
+        "perf-trend", "--ledger", str(ledger),
+        "--max-regression", "0.01",
+    ]) == EXIT_INVALID
+    capsys.readouterr()
+
+
+def test_bench_trend_row_shape_and_append(tmp_path):
+    """bench.trend_row_from_record pulls exactly the columns
+    perf-trend renders; append_trend_row survives repeated appends
+    and a pre-existing unterminated file."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import bench
+
+    record = {
+        "value": 1234.5, "vs_baseline": 2.5, "vs_python_oracle": 31.0,
+        "sync_floor_ms": 94.2, "trace_overhead_pct": 0.7,
+        "residency": {"syncs_per_check": 1.0,
+                      "double_buffer_occupancy": 2.0},
+    }
+    row = bench.trend_row_from_record(
+        record, ts="2026-08-06T01:02:03+00:00", smoke=True
+    )
+    assert row["ops_per_sec"] == 1234.5
+    assert row["vs_baseline"] == 2.5
+    assert row["syncs_per_check"] == 1.0
+    assert row["double_buffer_occupancy"] == 2.0
+    assert row["trace_overhead_pct"] == 0.7
+    assert row["smoke"] is True
+
+    ledger = str(tmp_path / "trend.jsonl")
+    bench.append_trend_row(row, ledger)
+    bench.append_trend_row(dict(row, vs_baseline=2.6), ledger)
+    rows = [json.loads(ln) for ln in open(ledger) if ln.strip()]
+    assert len(rows) == 2
+    assert rows[0]["vs_baseline"] == 2.5
+    assert rows[1]["vs_baseline"] == 2.6
+    # a torn last line (no newline) is repaired, not corrupted
+    with open(ledger, "a") as f:
+        f.write(json.dumps(row))
+    bench.append_trend_row(dict(row, vs_baseline=2.7), ledger)
+    rows = [json.loads(ln) for ln in open(ledger) if ln.strip()]
+    assert rows[-1]["vs_baseline"] == 2.7 and len(rows) == 4

@@ -167,3 +167,48 @@ def test_host_prep_2x_on_100k_stream():
     assert out["n_history_ops"] >= 100_000
     assert out["native"] is True
     assert out["speedup"] >= 2.0, out
+
+
+def test_tracing_on_overhead_bounded_8dev_mesh():
+    """Leaving the flight recorder ON during a real 8-device sharded
+    check must cost a bounded fraction of the check's wall — emission
+    is per-thread ring appends, O(1) per plane crossing, so on/off is
+    a same-host ratio assertion (min-of-N to shed scheduler noise),
+    never an absolute-time bar. The guard exists to catch an
+    accidental O(events) insert on the hot path."""
+    import time
+
+    from jepsen_tpu import obs
+    from jepsen_tpu.checker.events import history_to_events
+    from jepsen_tpu.checker.sharded import check_keys, default_mesh
+    from jepsen_tpu.sim import gen_register_history
+
+    streams = []
+    for seed in range(8):
+        rng = random.Random(seed)
+        h = gen_register_history(rng, n_ops=200, n_procs=3)
+        streams.append(history_to_events(h))
+    mesh = default_mesh()
+
+    def one_pass():
+        t0 = time.perf_counter()
+        res = check_keys(streams, mesh=mesh)
+        t1 = time.perf_counter()
+        assert all(bool(r["valid?"]) for r in res)
+        return t1 - t0
+
+    was_enabled = obs.TRACER.enabled
+    try:
+        obs.disable()
+        one_pass()  # warm the jit cache outside both measurements
+        off = min(one_pass() for _ in range(3))
+        obs.enable()
+        on = min(one_pass() for _ in range(3))
+    finally:
+        obs.reset()
+        if was_enabled:
+            obs.enable()
+        else:
+            obs.disable()
+    # generous budget + absolute slack: recorder cost should be noise
+    assert on <= off * 1.5 + 0.05, (on, off)

@@ -346,6 +346,31 @@ def f(a):
     return scan(a)
 """,
     ),
+    "JT304": (
+        # emission inside a per-device loop: ring churn scales with
+        # mesh size (on a pod: hosts x chips events per logical step)
+        """
+from jepsen_tpu.obs import trace as obs_trace
+
+def collect(devices):
+    out = []
+    for d in devices:
+        out.append(str(d))
+        obs_trace.instant("collect", kind="mesh", device=str(d))
+    return out
+""",
+        # sanctioned spelling: ONE aggregate emission after the loop
+        """
+from jepsen_tpu.obs import trace as obs_trace
+
+def collect(devices):
+    out = []
+    for d in devices:
+        out.append(str(d))
+    obs_trace.instant("collect", kind="mesh", n=len(devices))
+    return out
+""",
+    ),
     "JT401": (
         # ABBA: two locks nested in conflicting orders across
         # functions — the classic latent deadlock
@@ -540,7 +565,7 @@ def test_rule_catalog_partitions_by_family():
     all_rules = list(analysis.META_RULES) + family_rules
     assert len(all_rules) == len(set(all_rules))
     assert set(all_rules) == set(analysis.RULES)
-    assert analysis.rules_total() == len(analysis.RULES) == 22
+    assert analysis.rules_total() == len(analysis.RULES) == 23
 
 
 def test_host_get_funnel_itself_is_exempt():
@@ -900,7 +925,7 @@ def test_cli_json_contract():
     assert rec["clean"] is True
     assert rec["findings"] == []
     # per-rule descriptions and the catalog size ride the report
-    assert rec["rules_total"] == analysis.rules_total() == 22
+    assert rec["rules_total"] == analysis.rules_total() == 23
     assert set(rec["rules"]) == set(analysis.RULES)
     for meta in rec["rules"].values():
         assert meta["title"] and meta["invariant"]

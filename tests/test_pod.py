@@ -328,6 +328,51 @@ def test_graft_entry_pod_contract(capfd):
     assert rec["scaling_efficiency"] >= 0.6
     assert rec["syncs_per_check"] == 1.0
     assert rec["value"] > 0
+    # Pod flight recorder: every member persisted its ring, the
+    # coordinator merged them onto one timeline, and the metric line
+    # aggregates the launch-plane counters across ALL members (the
+    # per-member breakdown rides along for attribution).
+    assert rec["trace_members"] == 2
+    members = rec["members"]
+    assert [m["process_index"] for m in members] == [0, 1]
+    for m in members:
+        assert m["launches"] > 0
+        assert m["host_syncs"] >= 0
+        assert m["trace_spans"] > 0
+    assert rec["launches"] == sum(m["launches"] for m in members)
+    assert rec["host_syncs"] == sum(m["host_syncs"] for m in members)
+    assert rec["trace_spans"] == sum(m["trace_spans"] for m in members)
+    # The merged artifact is ONE schema-valid Perfetto/Chrome trace
+    # with a process row per member, spans from BOTH, and a disclosed
+    # clock-skew bound.
+    from jepsen_tpu.obs.export import validate_chrome_trace
+
+    with open(rec["trace_path"]) as f:
+        merged = json.load(f)
+    assert validate_chrome_trace(merged) == []
+    names = {
+        e["args"]["name"]: e["pid"]
+        for e in merged["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert set(names) == {"pod-member-0", "pod-member-1"}
+    span_pids = {
+        e["pid"] for e in merged["traceEvents"] if e.get("ph") == "X"
+    }
+    assert set(names.values()) <= span_pids
+    meta = merged["metadata"]
+    assert meta["schema"] == 1
+    assert "clock_skew_bound_ns" in meta
+    assert len(meta["members"]) == 2
+    # and trace-summary --by-process attributes wall per member from
+    # the file alone
+    from jepsen_tpu.cli import EXIT_VALID, main
+
+    assert main(
+        ["trace-summary", rec["trace_path"], "--by-process"]
+    ) == EXIT_VALID
+    out = capfd.readouterr()[0]
+    assert "pod-member-0" in out and "pod-member-1" in out
 
 
 @pytest.mark.slow

@@ -103,6 +103,13 @@ def test_graft_entry_contract(capfd):
     # findings (hot-path residency + lock discipline hold at review
     # time, not just at runtime).
     assert rec["lint_findings"] == 0
+    # Observability rides the same line: launch-plane accounting and
+    # the flight-recorder membership. A single-process dryrun is a
+    # one-member pod (trace_members=1); the pod dryrun's contract in
+    # test_pod.py sums these same counters across members.
+    assert isinstance(rec["launches"], int) and rec["launches"] > 0
+    assert isinstance(rec["host_syncs"], int) and rec["host_syncs"] > 0
+    assert rec["trace_members"] == 1
     # ... and names the rule catalog that judged it: all five
     # families (A hotpath, B concurrency, C obsrules, D lockorder,
     # E podrules/determinism) plus the meta rules.
