@@ -192,6 +192,13 @@ RULES: Dict[str, Tuple[str, str]] = {
         "loop — ring churn must stay O(1) per plane crossing, not "
         "O(mesh size); emit the aggregate after the loop",
     ),
+    "JT305": (
+        "per-append launch inside a stream loop",
+        "loops over stream appends/chunks route their tails through "
+        "the dispatch plane's stream bucket — a direct launch or "
+        "collect per append pays the one-sync floor k times where "
+        "the coalesced bucket pays it ~k/bucket_size times",
+    ),
     "JT401": (
         "lock-order cycle",
         "plane locks nest in one global order — a cycle in the "
@@ -235,7 +242,7 @@ META_RULES: Tuple[str, ...] = ("JT000", "JT001")
 FAMILY_RULES: Dict[str, Tuple[str, ...]] = {
     "A": ("JT101", "JT102", "JT103", "JT104", "JT105", "JT106"),
     "B": ("JT201", "JT202", "JT203", "JT204", "JT205"),
-    "C": ("JT301", "JT302", "JT303", "JT304"),
+    "C": ("JT301", "JT302", "JT303", "JT304", "JT305"),
     "D": ("JT401", "JT402", "JT403"),
     "E": ("JT501", "JT502", "JT503"),
 }

@@ -25,6 +25,15 @@ runtime cannot enforce:
   and the ring's drop-on-overflow then evicts the events that
   mattered. Emit once after the loop with the aggregate
   (``n=len(devices)``) instead.
+- JT305 no direct launch/collect call inside a loop over stream
+  appends: a per-append device launch pays the one-sync floor once
+  PER APPEND, where routing the tail through the dispatch plane's
+  stream bucket (``plane.submit_stream_tail(...)`` + ``fut.result()``)
+  coalesces same-shape tails into one stacked launch — k appends cost
+  ~k/bucket_size launches instead of k. The rule keys on the loop's
+  shape (iterable/target named for appends, chunks, or tails) and the
+  callee's (known dispatch/collect entry points); plane submits are
+  the sanctioned spelling and never match.
 
 Lock-scope inference matches Family B (``with <...lock...>:``), and
 traced-closure inference reuses Family A's ``ModuleInfo`` fixpoint.
@@ -63,6 +72,23 @@ _MESH_BOUND_TAILS = {
 }
 #: loop targets that name the per-device / per-member element
 _MESH_TARGET_NAMES = {"device", "dev", "member", "shard"}
+
+#: iterables whose loops walk stream appends by construction
+#: (``for chunk in stream_appends:``, ``for a in appends:`` ...)
+_STREAM_ITER_TAILS = {
+    "appends", "stream_appends", "chunks", "stream_chunks",
+    "tails", "stream_tails", "pending_appends",
+}
+#: loop targets that name the per-append element
+_STREAM_TARGET_NAMES = {"chunk", "append_ops", "tail_ops"}
+#: direct launch / collect entry points whose per-append use defeats
+#: stream-tail coalescing (the plane's submit_stream_tail does NOT
+#: appear here — routing through the plane IS the sanctioned fix)
+_STREAM_LAUNCH_TAILS = {
+    "check_steps_bitset", "check_steps_bitset_segmented",
+    "check_keys_bitset", "launch_tails_bitset", "_run_chain",
+    "_bitset_scan", "_host_get", "device_get", "block_until_ready",
+}
 
 
 def _target_names(t: ast.AST) -> Set[str]:
@@ -103,6 +129,26 @@ def _per_mesh_loop(node: ast.For) -> bool:
     )
 
 
+def _stream_iterable(node: ast.AST) -> bool:
+    """Does this loop iterable walk stream appends?"""
+    seg = _last_seg(node)
+    if seg in _STREAM_ITER_TAILS:
+        return True
+    if isinstance(node, ast.Call):
+        fseg = _last_seg(node.func)
+        if fseg in _STREAM_ITER_TAILS:
+            return True
+        if fseg in ("enumerate", "sorted", "reversed", "zip", "list"):
+            return any(_stream_iterable(a) for a in node.args)
+    return False
+
+
+def _per_append_loop(node: ast.For) -> bool:
+    return _stream_iterable(node.iter) or bool(
+        _target_names(node.target) & _STREAM_TARGET_NAMES
+    )
+
+
 class ObsChecker(ast.NodeVisitor):
     def __init__(self, tree: ast.Module, rel: str):
         self.tree = tree
@@ -124,6 +170,8 @@ class ObsChecker(ast.NodeVisitor):
         self.traced_depth = 0
         #: depth of enclosing per-device / per-member loops (JT304)
         self.mesh_loop_depth = 0
+        #: depth of enclosing stream-append loops (JT305)
+        self.stream_loop_depth = 0
 
     @property
     def symbol(self) -> str:
@@ -154,6 +202,7 @@ class ObsChecker(ast.NodeVisitor):
         # a nested def's body runs when CALLED, not per loop
         # iteration — its mesh-loop context starts fresh
         in_loop, self.mesh_loop_depth = self.mesh_loop_depth, 0
+        in_stream, self.stream_loop_depth = self.stream_loop_depth, 0
         traced = (
             node.name in self.info.traced
             or node.name in self.info.jit_impls
@@ -163,6 +212,7 @@ class ObsChecker(ast.NodeVisitor):
         self.generic_visit(node)
         self.traced_depth -= 1 if traced else 0
         self.mesh_loop_depth = in_loop
+        self.stream_loop_depth = in_stream
         self.locks = held
         self.symbols.pop()
 
@@ -196,12 +246,15 @@ class ObsChecker(ast.NodeVisitor):
 
     def visit_For(self, node: ast.For) -> None:
         mesh = _per_mesh_loop(node)
+        stream = _per_append_loop(node)
         self.visit(node.iter)
         self.visit(node.target)
         self.mesh_loop_depth += 1 if mesh else 0
+        self.stream_loop_depth += 1 if stream else 0
         for stmt in node.body:
             self.visit(stmt)
         self.mesh_loop_depth -= 1 if mesh else 0
+        self.stream_loop_depth -= 1 if stream else 0
         for stmt in node.orelse:
             self.visit(stmt)
 
@@ -244,6 +297,18 @@ class ObsChecker(ast.NodeVisitor):
                     "drop-on-overflow evicts the events that matter; "
                     "emit once after the loop with the aggregate "
                     "(n=len(devices))",
+                )
+        if self.stream_loop_depth > 0:
+            seg = _last_seg(node.func)
+            if seg in _STREAM_LAUNCH_TAILS:
+                self.add(
+                    "JT305", node,
+                    f"{seg}(...) launched per append inside a stream "
+                    "loop — each iteration pays the one-sync launch "
+                    "floor; route the tail through the dispatch "
+                    "plane's stream bucket (plane.submit_stream_tail "
+                    "+ fut.result()) so same-shape tails coalesce "
+                    "into one stacked launch",
                 )
         self.generic_visit(node)
 

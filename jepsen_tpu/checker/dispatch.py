@@ -157,6 +157,13 @@ DISPATCH_STATS = {
     # means concurrent graph checks actually shared a launch.
     "graph_requests": 0,
     "graph_batches": 0,
+    # Stream-tail bucket kind (checker/streaming.py): per-append tail
+    # submissions accepted and the stacked tail launches formed from
+    # them — stream_requests / stream_batches > 1 means concurrent
+    # streams' appends actually shared a launch (each stream's
+    # device-resident frontier feeds row i of the stack).
+    "stream_requests": 0,
+    "stream_batches": 0,
 }
 
 _stats_lock = threading.Lock()
@@ -373,8 +380,10 @@ class _Launch:
 
     def device_out(self):
         """The device arrays one host fetch must materialize — fed to a
-        single jax.device_get over the whole launch-train prefix."""
-        if self.kind == "bitset":
+        single jax.device_get over the whole launch-train prefix.
+        Stream launches fetch VERDICTS only: the stacked fr_out stays
+        device-resident (each rider's next frontier is a row slice)."""
+        if self.kind in ("bitset", "stream"):
             return self.handle[0]
         if self.kind == "segmented":
             return tuple(self.handle[0])
@@ -574,6 +583,59 @@ class DispatchPlane:
                    bool(need[1]))
         _bump("requests")
         _bump("graph_requests")
+        full = None
+        with self._lock:
+            b = self._buckets.get(fut.key)
+            if b is None:
+                b = self._buckets[fut.key] = _Bucket()
+            b.futs.append(fut)
+            fut._bucketed_at = time.perf_counter()
+            if len(b.futs) >= self.max_batch:
+                full = fut.key
+        if full is not None:
+            self._flush_bucket(full)
+        elif self._worker is not None:
+            self._wake.set()
+        return fut
+
+    def submit_stream_tail(
+        self,
+        steps,
+        frontier,
+        model: Optional[str] = None,
+        S: int = 8,
+        exact: bool = False,
+    ) -> CheckFuture:
+        """Queue one stream's unchecked TAIL (the "stream" bucket
+        kind, checker/streaming.py): ``steps`` is a single-W
+        ReturnSteps slice and ``frontier`` the stream's boundary
+        frontier — None for a fresh stream, a host array, or (the
+        steady state) the device-resident row a previous stacked tail
+        launch left behind. Concurrent streams sharing a kernel shape
+        (model, S, W, length bucket, tier) coalesce into ONE stacked
+        bitset launch (wgl_bitset.launch_tails_bitset); the future
+        resolves to the raw ``(alive, taint, died, fr_row)`` tuple
+        where fr_row is the stream's NEXT frontier as a device-side
+        slice — frontiers never cross to the host between appends.
+        Escalation/death semantics stay with the StreamingCheck (fast
+        deaths are provisional; the handle re-runs sticky-exact)."""
+        name = model or self.model
+        name = name if isinstance(name, str) else name.name
+        fut = CheckFuture(self, None, name)
+        fut.kind = "stream"
+        fut.wrap = False
+        fut.steps = steps
+        fut.frontier = frontier
+        fut.S = S
+        fut.W = steps.W
+        n = bucket(max(len(steps), 1), 64)
+        fut.key = (
+            "stream", name, S, steps.W, n, self.interpret, bool(exact)
+        )
+        _bump("requests")
+        _bump("stream_requests")
+        obs_trace.instant("submit_stream", kind="dispatch",
+                          tenant=current_tenant())
         full = None
         with self._lock:
             b = self._buckets.get(fut.key)
@@ -1127,6 +1189,9 @@ class DispatchPlane:
                 # planelint: disable=JT502 reason=same data-uniform bucket-kind key as the branch above
                 elif key[0] == "graph":
                     self._dispatch_graph_batch(b.futs, key)
+                # planelint: disable=JT502 reason=same data-uniform bucket-kind key as the branches above
+                elif key[0] == "stream":
+                    self._dispatch_stream_batch(b.futs, key)
                 else:
                     self._dispatch_vmap_batch(b.futs, key)
         except BaseException as e:  # noqa: BLE001
@@ -1152,6 +1217,40 @@ class DispatchPlane:
             "model": name, "S": S, "interpret": interpret,
             "exact": exact,
         })
+        launch.handle = handle
+        self._note_launch(len(futs), mesh_used)
+        self._register_launch(launch)
+
+    def _dispatch_stream_batch(self, futs, key) -> None:
+        """Stack same-shape stream tails + their resident frontiers
+        into one bitset launch. A ladder-exhausted dispatch fails the
+        riders with the PlaneFault: the StreamingCheck catches it and
+        falls back to its direct (solo) tail chain, so a degraded
+        plane costs coalescing, never verdicts."""
+        _, name, S, _W, _n, interpret, exact = key
+
+        def launch_with(mesh):
+            return bs.launch_tails_bitset(
+                [f.steps for f in futs],
+                [f.frontier for f in futs],
+                model=name, S=S, interpret=interpret, exact=exact,
+                mesh=mesh,
+            )
+
+        handle, mesh_used, pf = self._dispatch_resilient(
+            launch_with, tags=_tenant_tags(futs)
+        )
+        if handle is None:
+            # No oracle arm here: the frontier chain is the stream
+            # handle's state, so degradation belongs to streaming.py
+            # (it retries the tail solo and owns escalation).
+            for f in futs:
+                chaos.note_plane_fault()
+                self._observe(f, "plane_fault")
+                f._fail(pf)
+            return
+        _bump("stream_batches")
+        launch = _Launch("stream", futs, {})
         launch.handle = handle
         self._note_launch(len(futs), mesh_used)
         self._register_launch(launch)
@@ -1457,8 +1556,25 @@ class DispatchPlane:
             self._resolve_segmented(launch, host)
         elif launch.kind == "graph":
             self._resolve_graph(launch, host)
+        elif launch.kind == "stream":
+            self._resolve_stream(launch, host)
         else:
             self._resolve_vmap(launch, host)
+
+    def _resolve_stream(self, launch: _Launch, host) -> None:
+        """Hand each stream rider its raw fast verdict plus its NEXT
+        frontier as a device-side row slice of the stacked fr_out —
+        the one fetch this train already paid covered the verdict
+        array only, so frontiers stay resident for the next append's
+        stacked launch. No escalation here: a provisional fast death
+        is the StreamingCheck's to re-run sticky-exact."""
+        fr_out = launch.handle[1][0]
+        n_real = launch.handle[1][-1]
+        verdicts = bs._out_to_verdicts(np.asarray(host))[:n_real]
+        for i, (f, v) in enumerate(zip(launch.futs, verdicts)):
+            if not f.done():
+                alive, taint, died = v
+                f._resolve((alive, taint, died, fr_out[i]))
 
     def _resolve_graph(self, launch: _Launch, host) -> None:
         """Slice the stacked per-graph count arrays back out to each
@@ -1731,6 +1847,21 @@ def default_plane(**kw) -> DispatchPlane:
             kw.setdefault("async_prep", False)
             _DEFAULT_PLANE = DispatchPlane(**kw)
         return _DEFAULT_PLANE
+
+
+def drain_default_plane() -> None:
+    """Collect the process-wide plane's outstanding launch train
+    (no-op when no plane exists). A native-racer win resolves its
+    rider without forcing the train's collect (_drive returns on
+    fut.done() before _collect_upto), so an end-of-run accounting
+    snapshot taken right after the last verdict can otherwise miss
+    the train's host sync — and leave its device buffers pinned.
+    End-of-run reporters (cli results.json / analyze --trace) call
+    this before reading stats so the ledger is complete."""
+    with _default_lock:
+        plane = _DEFAULT_PLANE
+    if plane is not None:
+        plane.drain()
 
 
 def reset_default_plane() -> None:

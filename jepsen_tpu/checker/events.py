@@ -691,11 +691,24 @@ def history_to_events(
     model: Any = "cas-register",
     init_value: Any = None,
     max_window: int = MAX_WINDOW,
+    value_codes: Optional[Dict[Any, int]] = None,
+    min_window: int = 0,
 ) -> EventStream:
     """Encode a record history into an EventStream for the given model.
 
     Raises WindowOverflow if concurrency (open ops incl. crashed ones)
     exceeds max_window.
+
+    value_codes / min_window seed the encoder so a stream SUFFIX sealed
+    at a clean boundary (no open invokes crossing it) re-encodes to the
+    exact rows the full history would produce there: the interning
+    table is append-only (prefix codes are frozen), and the returned
+    window never shrinks below the sealed prefix's high-water (so the
+    W-bucket choice — and with it the kernel shape — is stable). Slot
+    assignment needs no seed: the min-heap recycler hands a cold
+    encoder slots 0,1,2,... exactly as the warm one's fully-returned
+    free heap would (streaming.py's windowed frontier GC relies on all
+    three properties).
     """
     m: Model = get_model(model)
     h = history.complete()
@@ -705,7 +718,7 @@ def history_to_events(
     # typed-equality discipline as the columnar encoder).
     from jepsen_tpu.history.columnar import intern_key
 
-    codes: Dict[Any, int] = {}
+    codes: Dict[Any, int] = dict(value_codes) if value_codes else {}
 
     def code(v) -> int:
         if v is None:
@@ -740,7 +753,7 @@ def history_to_events(
     free: List[int] = []
     next_fresh = 0
     open_slot: Dict[int, int] = {}  # invocation index -> slot
-    window = 0
+    window = max(int(min_window), 0)
     n_ops = 0
 
     pairs = h.pairs()

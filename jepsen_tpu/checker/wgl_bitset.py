@@ -1345,6 +1345,109 @@ def collect_keys_bitset(handle, out_host=None) -> List[Tuple[bool, bool, int]]:
     return _out_to_verdicts(np.asarray(_host_get(out2)))[:n_real]
 
 
+def launch_tails_bitset(
+    steps_list,
+    frontiers,
+    model: str = "cas-register",
+    S: int = 8,
+    interpret: bool = False,
+    exact: bool = False,
+    mesh=None,
+):
+    """Dispatch a stack of stream TAILS in one launch: like
+    launch_keys_bitset, but row i chains from stream i's OWN boundary
+    frontier (``frontiers[i]``: a device-resident [S, M] row from a
+    previous stacked launch, a host [S, M] / [1, S, M] array, or None
+    for a fresh stream = init_frontier) instead of a cold init row —
+    and the handle KEEPS the stacked fr_out, so each stream's next
+    frontier is a device-side row slice, never a host sync.
+
+    All tails must share (model, S, W); lengths pad to one power-of-two
+    bucket (the dispatch plane's "stream" bucket key guarantees both).
+    mesh (>1 device): rows pad to a mesh multiple with blank init rows
+    and the stack dispatches through the shard_map wrapper with
+    matched in/out key shardings — the same one-launch-one-sync shape
+    as batch buckets (single-process meshes; pod streams are not
+    routed here). Returns (out, handle); slice ``handle[0][i]`` for
+    stream i's boundary frontier after collecting ``out``."""
+    n = bucket(max(max(len(st) for st in steps_list), 1), 64)
+    name = model if isinstance(model, str) else model.name
+    W = steps_list[0].W
+    M = bitset_words(W)
+    wins, metas = [], []
+    for st in steps_list:
+        w, m = memo_on(
+            st, "_batch_args", n, lambda s=st: pack_steps(s.padded(n))
+        )
+        wins.append(w)
+        metas.append(m)
+    n_real = len(steps_list)
+    win_h = np.stack(wins)
+    meta_h = np.stack(metas)
+    n_dev = 0
+    if mesh is not None:
+        from jepsen_tpu.checker.sharded import mesh_size
+
+        n_dev = mesh_size(mesh)
+    # Frontier rows may live on different devices (each is a slice of
+    # an earlier stacked launch's sharded fr_out): normalize every row
+    # onto one device before stacking — a no-op when already there —
+    # so jnp.stack never sees conflicting committed placements.
+    dev0 = (
+        list(mesh.devices.flat)[0] if n_dev > 1 else jax.devices()[0]
+    )
+    rows = []
+    for st, fr in zip(steps_list, frontiers):
+        if fr is None:
+            fr = init_frontier(st.init_state, S, W)
+        r = jnp.asarray(fr).reshape(S, M)
+        rows.append(jax.device_put(r, dev0))
+    if n_dev > 1:
+        from jax.sharding import NamedSharding
+
+        from jepsen_tpu.checker.sharded import (
+            key_spec,
+            make_sharded_bitset,
+            note_sharded_launch,
+        )
+
+        pad = -n_real % n_dev
+        if pad:
+            win_h = np.concatenate([
+                win_h,
+                np.zeros((pad,) + win_h.shape[1:], win_h.dtype),
+            ])
+            meta_h = np.concatenate([
+                meta_h,
+                np.zeros((pad,) + meta_h.shape[1:], meta_h.dtype),
+            ])
+            blank = jnp.asarray(init_frontier(0, S, W))
+            rows.extend([jax.device_put(blank, dev0)] * pad)
+        sharding = NamedSharding(mesh, key_spec(mesh))
+        win_j = jax.device_put(jnp.asarray(win_h), sharding)
+        meta_j = jax.device_put(jnp.asarray(meta_h), sharding)
+        fr0 = jax.device_put(jnp.stack(rows), sharding)
+        fn = make_sharded_bitset(mesh, name, S, W, interpret, exact)
+        _bump_launch("launches")
+        note_sharded_launch(n_dev)
+        out, fr_out = fn(win_j, meta_j, fr0)
+    else:
+        mesh = None  # a 1-device mesh IS the single-device path
+        win_j = jnp.asarray(win_h)
+        meta_j = jnp.asarray(meta_h)
+        fr0 = jnp.stack(rows)
+        _bump_launch("launches")
+        out, fr_out = _bitset_scan(
+            win_j, meta_j, fr0,
+            model_name=name,
+            S=S,
+            W=W,
+            interpret=interpret,
+            exact=exact,
+        )
+    return out, (fr_out, name, S, W, interpret, exact, mesh, n_real)
+
+
 def check_keys_bitset(
     steps_list,
     model: str = "cas-register",

@@ -483,9 +483,14 @@ def _engine_stats() -> dict:
     process audit trail the kill-restart differential reads (a
     resumed run shows strictly fewer launches than the cold one).
     Same shape the daemon's /stats serves and the dryrun metric line
-    summarizes: obs.snapshot.engine_snapshot() is the one reader."""
+    summarizes: obs.snapshot.engine_snapshot() is the one reader.
+    Drains the default plane first: a native-racer win can leave the
+    launch train uncollected (its host sync unpaid and uncounted), and
+    this snapshot is the run's final ledger."""
+    from jepsen_tpu.checker.dispatch import drain_default_plane
     from jepsen_tpu.obs.snapshot import engine_snapshot
 
+    drain_default_plane()
     return engine_snapshot()
 
 
@@ -580,24 +585,28 @@ def _trace_summary_by_process(obj, evs, wall_ms: float) -> int:
 
 def cmd_perf_trend(args) -> int:
     """Render the bench trend ledger (bench_runs/trend.jsonl — one
-    compact row per bench run) and gate on regressions: exit 1 when
-    the latest row's vs_baseline geomean dropped more than
-    --max-regression (fractional) below the previous row's, exit 2
-    when there is no ledger to judge. The perf story stays observable
-    ACROSS runs, not just within one."""
-    import json
+    compact row per bench run) and gate on regressions PER MODE: smoke
+    rows (CPU flow validations) and hardware rows (real measurements)
+    form separate trajectories, and each mode's latest row is gated
+    against ITS OWN predecessor — a CPU smoke geomean is never
+    compared against a TPU hardware one. Exit 1 when any mode's
+    vs_baseline geomean dropped more than --max-regression
+    (fractional) below its previous row's, exit 2 when there is no
+    ledger to judge. The perf story stays observable ACROSS runs, not
+    just within one."""
     import os
+
+    from jepsen_tpu.obs.trend import (
+        gate_trend,
+        load_trend_rows,
+        trend_mode,
+    )
 
     path = args.ledger
     if not os.path.exists(path):
         print(f"perf-trend: no trend ledger at {path}")
         return EXIT_UNKNOWN
-    rows = []
-    with open(path, encoding="utf-8") as f:
-        for ln in f:
-            ln = ln.strip()
-            if ln:
-                rows.append(json.loads(ln))
+    rows = load_trend_rows(path)
     if not rows:
         print(f"perf-trend: empty trend ledger at {path}")
         return EXIT_UNKNOWN
@@ -606,37 +615,23 @@ def cmd_perf_trend(args) -> int:
         v = row.get(key)
         return f"{v:.3f}" if isinstance(v, (int, float)) else "-"
 
-    print(f"{'ts':<20} {'vs_base':>8} {'vs_py':>10} {'syncs':>6} "
-          f"{'floor_ms':>9} {'occup':>6} {'trace_ov%':>9} "
-          f"{'ops/s':>10}")
+    print(f"{'ts':<20} {'mode':<8} {'vs_base':>8} {'vs_py':>10} "
+          f"{'syncs':>6} {'floor_ms':>9} {'occup':>6} "
+          f"{'trace_ov%':>9} {'ops/s':>10}")
     for r in rows:
         ts = str(r.get("ts", "?"))[:19]
-        print(f"{ts:<20} {_num(r, 'vs_baseline'):>8} "
+        print(f"{ts:<20} {trend_mode(r):<8} "
+              f"{_num(r, 'vs_baseline'):>8} "
               f"{_num(r, 'vs_python_oracle'):>10} "
               f"{_num(r, 'syncs_per_check'):>6} "
               f"{_num(r, 'sync_floor_ms'):>9} "
               f"{_num(r, 'double_buffer_occupancy'):>6} "
               f"{_num(r, 'trace_overhead_pct'):>9} "
               f"{_num(r, 'ops_per_sec'):>10}")
-    if len(rows) < 2:
-        print(f"perf-trend: {len(rows)} row(s); nothing to compare yet")
-        return EXIT_VALID
-    prev = rows[-2].get("vs_baseline")
-    cur = rows[-1].get("vs_baseline")
-    if not isinstance(prev, (int, float)) or not isinstance(
-            cur, (int, float)) or prev <= 0:
-        print("perf-trend: vs_baseline missing on the last two rows; "
-              "no gate applied")
-        return EXIT_VALID
-    drop = (prev - cur) / prev
-    if drop > args.max_regression:
-        print(f"perf-trend: REGRESSION: vs_baseline {prev:.3f} -> "
-              f"{cur:.3f} ({drop * 100:.1f}% drop > "
-              f"{args.max_regression * 100:.1f}% budget)")
-        return EXIT_INVALID
-    print(f"perf-trend: ok: vs_baseline {prev:.3f} -> {cur:.3f} "
-          f"({len(rows)} runs on record)")
-    return EXIT_VALID
+    ok, msgs = gate_trend(rows, args.max_regression)
+    for m in msgs:
+        print(f"perf-trend: {m}")
+    return EXIT_VALID if ok else EXIT_INVALID
 
 
 def cmd_lint(args) -> int:

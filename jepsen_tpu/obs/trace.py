@@ -17,6 +17,16 @@ Design constraints, in order:
 3. **Bounded memory.** Rings trim themselves (owner-side ``del``)
    back to ``capacity`` once they reach twice it; trimmed events
    count in ``dropped`` so a truncated trace is detectable.
+5. **Production-rate emission is tunable, not all-or-nothing.**
+   ``enable(kinds=..., sample_n=N)`` installs a per-kind enable mask
+   (kinds outside it emit nothing) and 1-in-N sampling for the kinds
+   that remain: every Nth emission records, the rest count in the
+   owner ring's ``sampled_out`` metadata (surfaced by trace_stats, so
+   a sampled trace is detectable exactly like a trimmed one). The
+   sampled-out path reads no clock and touches no ring — at the
+   production config (dispatch-only kinds, sample_n >= 16) the jitted
+   launch-loop probe stays within 10% of tracing-off (pinned by
+   test_perf_regression and re-measured into the bench trend ledger).
 4. **Monotonic clock.** Timestamps are ``time.perf_counter_ns()`` —
    spans measure real elapsed wall on one host, immune to wall-clock
    steps (the nemesis bends wall clocks on purpose).
@@ -106,8 +116,13 @@ class Tracer:
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self.enabled = False
         self.capacity = capacity
-        #: tid -> {"ring": list, "tname": str}; created lazily on a
-        #: thread's first emission, under _rings_lock
+        #: record only these kinds (None = every kind)
+        self.kinds: Optional[frozenset] = None
+        #: record every Nth surviving emission (1 = all)
+        self.sample_n = 1
+        #: tid -> {"ring": list, "tname": str, "seq", "sampled_out"};
+        #: created lazily on a thread's first emission, under
+        #: _rings_lock
         self._rings: Dict[int, dict] = {}
         self._rings_lock = threading.Lock()
         self._local = threading.local()
@@ -115,9 +130,20 @@ class Tracer:
 
     # -- lifecycle -----------------------------------------------------
 
-    def enable(self, capacity: Optional[int] = None) -> None:
+    def enable(
+        self,
+        capacity: Optional[int] = None,
+        kinds=None,
+        sample_n: Optional[int] = None,
+    ) -> None:
+        """Turn recording on. ``kinds`` (an iterable of kind strings)
+        installs the per-kind enable mask; ``sample_n`` the 1-in-N
+        sampler. Omitted knobs RESET to record-everything — a plain
+        ``enable()`` is the historical full-fidelity mode."""
         if capacity is not None:
             self.capacity = int(capacity)
+        self.kinds = frozenset(kinds) if kinds is not None else None
+        self.sample_n = max(int(sample_n), 1) if sample_n else 1
         self.enabled = True
 
     def disable(self) -> None:
@@ -129,6 +155,8 @@ class Tracer:
         with self._rings_lock:
             for ent in self._rings.values():
                 del ent["ring"][:]
+                ent["seq"] = 0
+                ent["sampled_out"] = 0
             self._dropped = 0
 
     def clear(self) -> None:
@@ -140,18 +168,38 @@ class Tracer:
 
     # -- emission (hot path) -------------------------------------------
 
-    def _ring(self) -> list:
+    def _ent(self) -> dict:
         ent = getattr(self._local, "ent", None)
         if ent is None:
             t = threading.current_thread()
-            ent = {"ring": [], "tname": t.name}
+            ent = {
+                "ring": [], "tname": t.name,
+                "seq": 0, "sampled_out": 0,
+            }
             with self._rings_lock:
                 self._rings[t.ident] = ent
             self._local.ent = ent
-        return ent["ring"]
+        return ent
+
+    def _admit(self, kind: str) -> bool:
+        """The sampling gate, decided BEFORE any clock read or record
+        allocation. Masked-out kinds vanish silently (they were never
+        enabled); sampled-out emissions of enabled kinds count in the
+        owner ring's metadata so the thinning is visible."""
+        if self.kinds is not None and kind not in self.kinds:
+            return False
+        n = self.sample_n
+        if n <= 1:
+            return True
+        ent = self._ent()
+        seq = ent["seq"] = ent["seq"] + 1
+        if seq % n:
+            ent["sampled_out"] += 1
+            return False
+        return True
 
     def _emit(self, rec: dict) -> None:
-        ring = self._ring()
+        ring = self._ent()["ring"]
         ring.append(rec)
         # owner-side trim: only this thread ever mutates its ring, so
         # the del cannot race another writer; snapshot readers copy
@@ -181,7 +229,8 @@ class Tracer:
 
     def trace_stats(self) -> dict:
         """Counter view for the engine snapshot / metric lines:
-        event totals by phase and per-kind counts."""
+        event totals by phase and per-kind counts, plus the sampling
+        config and how many emissions it thinned away."""
         evs = self.spans()
         by_kind: Dict[str, int] = {}
         n_spans = n_instants = 0
@@ -191,12 +240,19 @@ class Tracer:
                 n_spans += 1
             else:
                 n_instants += 1
+        with self._rings_lock:
+            sampled_out = sum(
+                e["sampled_out"] for e in self._rings.values()
+            )
         return {
             "enabled": self.enabled,
             "events": len(evs),
             "spans": n_spans,
             "instants": n_instants,
             "dropped": self._dropped,
+            "sample_n": self.sample_n,
+            "kinds": sorted(self.kinds) if self.kinds is not None else None,
+            "sampled_out": sampled_out,
             "by_kind": by_kind,
         }
 
@@ -206,8 +262,12 @@ class Tracer:
 TRACER = Tracer()
 
 
-def enable(capacity: Optional[int] = None) -> None:
-    TRACER.enable(capacity)
+def enable(
+    capacity: Optional[int] = None,
+    kinds=None,
+    sample_n: Optional[int] = None,
+) -> None:
+    TRACER.enable(capacity, kinds=kinds, sample_n=sample_n)
 
 
 def disable() -> None:
@@ -220,8 +280,12 @@ def reset() -> None:
 
 def span(name: str, kind: str = "span", **attrs):
     """Open a duration span (ALWAYS ``with span(...):`` — planelint
-    JT301). Disabled mode returns the no-op singleton."""
+    JT301). Disabled mode returns the no-op singleton; so do
+    masked-out kinds and sampled-out emissions (no clock read, no
+    record)."""
     if not TRACER.enabled:
+        return _NOOP
+    if not TRACER._admit(kind):
         return _NOOP
     return _Span(TRACER, name, kind, attrs)
 
@@ -229,6 +293,8 @@ def span(name: str, kind: str = "span", **attrs):
 def instant(name: str, kind: str = "instant", **attrs) -> None:
     """Record a zero-duration event (stat bumps, retries, ejections)."""
     if not TRACER.enabled:
+        return
+    if not TRACER._admit(kind):
         return
     TRACER._emit({
         "name": name,

@@ -1,0 +1,97 @@
+"""The bench trend ledger, shared between bench.py (writer +
+preflight gate) and cli perf-trend (renderer + gate).
+
+One compact JSON row per bench run lands in bench_runs/trend.jsonl.
+Rows carry a ``mode``: "smoke" rows are flow validations on whatever
+host ran them (CPU interpret, virtual meshes), "hardware" rows are
+real measurements. The two populations measure different things — a
+CPU smoke geomean around 2.5 against a TPU hardware geomean around 11
+is not a regression, it is a category error — so every comparison in
+this module is WITHIN one mode's trajectory, never across. Rows from
+before the mode field infer it from the older ``smoke`` bool.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Tuple
+
+#: default ledger location (bench.py appends, perf-trend reads)
+TREND_LEDGER_PATH = "bench_runs/trend.jsonl"
+
+
+def ledger_path(path: Optional[str] = None) -> str:
+    return path or os.environ.get(
+        "JEPSEN_TPU_TREND_LEDGER", TREND_LEDGER_PATH
+    )
+
+
+def load_trend_rows(path: Optional[str] = None) -> List[dict]:
+    """Every row in the ledger, in append order ([] when absent —
+    callers distinguish missing-vs-empty via os.path.exists)."""
+    path = ledger_path(path)
+    rows: List[dict] = []
+    if not os.path.exists(path):
+        return rows
+    with open(path, encoding="utf-8") as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln:
+                rows.append(json.loads(ln))
+    return rows
+
+
+def trend_mode(row: dict) -> str:
+    """A row's trajectory: the explicit mode field when present,
+    inferred from the legacy smoke bool otherwise."""
+    mode = row.get("mode")
+    if isinstance(mode, str) and mode:
+        return mode
+    return "smoke" if row.get("smoke") else "hardware"
+
+
+def gate_trend(
+    rows: List[dict], max_regression: float
+) -> Tuple[bool, List[str]]:
+    """The regression gate, per mode: within each mode's trajectory,
+    the latest row's vs_baseline geomean must not sit more than
+    ``max_regression`` (fractional) below its predecessor's. Returns
+    (ok, messages) — ok False when ANY mode's trajectory regressed.
+    Trajectories with under two comparable rows pass vacuously (the
+    message says so)."""
+    by_mode: dict = {}
+    for r in rows:
+        by_mode.setdefault(trend_mode(r), []).append(r)
+    ok = True
+    msgs: List[str] = []
+    for mode in sorted(by_mode):
+        traj = [
+            r for r in by_mode[mode]
+            if isinstance(r.get("vs_baseline"), (int, float))
+        ]
+        if len(traj) < 2:
+            msgs.append(
+                f"{mode}: {len(traj)} comparable row(s); "
+                "nothing to compare yet"
+            )
+            continue
+        prev = traj[-2]["vs_baseline"]
+        cur = traj[-1]["vs_baseline"]
+        if prev <= 0:
+            msgs.append(f"{mode}: non-positive baseline; no gate")
+            continue
+        drop = (prev - cur) / prev
+        if drop > max_regression:
+            ok = False
+            msgs.append(
+                f"{mode}: REGRESSION: vs_baseline {prev:.3f} -> "
+                f"{cur:.3f} ({drop * 100:.1f}% drop > "
+                f"{max_regression * 100:.1f}% budget)"
+            )
+        else:
+            msgs.append(
+                f"{mode}: ok: vs_baseline {prev:.3f} -> {cur:.3f} "
+                f"({len(traj)} runs on record)"
+            )
+    return ok, msgs

@@ -409,14 +409,26 @@ class CheckerDaemon:
         """(status, response dict) for one chunk of a streaming check.
 
         Request: {"stream_id": str, "ops": [op...], "final": bool,
-                  "model"?, "init_value"?, "durable"?}. Chunks append
-        into one per-(tenant, stream_id) StreamingCheck — only the new
-        tail of the step stream launches (checker/streaming.py).
-        Non-final chunks answer 202 with the provisional status; a
-        final chunk answers 200 with the definite verdict and drops
-        the handle. "durable" persists the stream frontier under the
-        service checkpoint root, so a daemon restart resumes the
-        stream when the client replays it from the start."""
+                  "model"?, "init_value"?, "durable"?, "deadline_s"?,
+                  "persist_every"?, "gc_window"?}. Chunks append into
+        one per-(tenant, stream_id) StreamingCheck — routed through
+        the shared dispatch plane's "stream" bucket, so concurrent
+        same-shape streams coalesce their tails into stacked launches
+        (checker/streaming.py module docstring). Non-final chunks
+        answer 202 with the provisional status; a final chunk answers
+        200 with the definite verdict and drops the handle.
+
+        "durable" persists the stream frontier under the service
+        checkpoint root (batched every ``persist_every`` appends), so
+        a daemon restart resumes the stream when the client replays it
+        from the start. "gc_window" bounds the stream's retained state
+        O(window) via frontier GC. "deadline_s" is the per-append SLO
+        budget: a chunk that lands over budget still answers (the
+        verdict is already computed — aborting would poison the
+        stream) but counts a stream_deadline_misses strike in the
+        tenant ledger and carries "deadline_miss": true; append wall
+        latency feeds the tenant's stream_p99_ms reservoir either
+        way."""
         from jepsen_tpu.checker.streaming import StreamingCheck
 
         try:
@@ -426,6 +438,9 @@ class CheckerDaemon:
                 raise ValueError("stream_id is required")
             ops = [op_from_json(d) for d in req.get("ops", [])]
             final = bool(req.get("final"))
+            deadline_s = req.get("deadline_s")
+            if deadline_s is not None:
+                deadline_s = float(deadline_s)
         except Exception as e:  # noqa: BLE001 - malformed request
             return 400, {"error": "bad-request", "detail": str(e)}
         key = (tenant, stream_id)
@@ -443,10 +458,15 @@ class CheckerDaemon:
                     init_value=req.get("init_value"),
                     interpret=self.interpret,
                     path=path,
+                    plane=self.plane,
+                    hold_s=self.coalesce_hold_s,
+                    persist_every=int(req.get("persist_every", 1)),
+                    gc_window=req.get("gc_window"),
                 )
                 ent = (sc, threading.Lock())
                 self._streams[key] = ent
         sc, sc_lock = ent
+        t0 = time.monotonic()
         try:
             with dispatch.tenant_context(tenant):
                 # Single-writer per STREAM: concurrent chunks of one
@@ -465,10 +485,23 @@ class CheckerDaemon:
                 self._streams.pop(key, None)
             return 500, {"error": "check-failed", "detail": str(e)}
         self.ledger.note(tenant, "stream_chunks")
+        # Per-append SLO accounting: every chunk's wall latency feeds
+        # the tenant p99 reservoir; over-budget chunks strike the
+        # deadline-miss counter (surfaced on /stats and /metrics).
+        elapsed_ms = (time.monotonic() - t0) * 1000.0
+        self.ledger.note_stream_latency(tenant, elapsed_ms)
+        missed = (
+            deadline_s is not None
+            and elapsed_ms > deadline_s * 1000.0
+        )
+        if missed:
+            self.ledger.note(tenant, "stream_deadline_misses")
         if not final:
             status = _jsonable(status)
             status["tenant"] = tenant
             status["stream_id"] = stream_id
+            if missed:
+                status["deadline_miss"] = True
             return 202, status
         with self._streams_lock:
             self._streams.pop(key, None)
@@ -481,6 +514,8 @@ class CheckerDaemon:
         out = _jsonable(out)
         out["tenant"] = tenant
         out["stream_id"] = stream_id
+        if missed:
+            out["deadline_miss"] = True
         return 200, out
 
 

@@ -50,7 +50,12 @@ _ROW = {
     "durable_resumes": 0,     # resumed past segment 0 on resubmit
     "durable_replays": 0,     # finished checkpoint answered launch-free
     "stream_chunks": 0,       # POST /check/stream chunks appended
+    "stream_deadline_misses": 0,  # appends past their deadline budget
 }
+
+#: stream append latency reservoir size per tenant (enough for a p99
+#: over the recent window without unbounded growth)
+_LAT_CAP = 512
 
 
 class TenantLedger:
@@ -69,6 +74,8 @@ class TenantLedger:
         self._rows: Dict[str, dict] = {}
         self._policy: Dict[str, bool] = {}  # tenant -> strict?
         self._first_seen: Dict[str, float] = {}
+        #: per-tenant stream append latency samples (ms), ring-capped
+        self._stream_lat: Dict[str, list] = {}
 
     # -- rows ----------------------------------------------------------
 
@@ -82,6 +89,17 @@ class TenantLedger:
     def note(self, tenant: str, key: str, n: int = 1) -> None:
         with self._lock:
             self._row(tenant)[key] += n
+
+    def note_stream_latency(self, tenant: str, ms: float) -> None:
+        """One stream append's wall latency into the tenant's SLO
+        reservoir (ring-capped at _LAT_CAP samples: the p99 tracks the
+        recent window, not all history)."""
+        with self._lock:
+            self._row(tenant)  # latency implies existence
+            lat = self._stream_lat.setdefault(tenant, [])
+            lat.append(float(ms))
+            if len(lat) > _LAT_CAP:
+                del lat[: len(lat) - _LAT_CAP]
 
     # -- policy --------------------------------------------------------
 
@@ -135,12 +153,29 @@ class TenantLedger:
     # -- views ---------------------------------------------------------
 
     def snapshot(self) -> dict:
-        """{tenant: row} plus breaker state — the /stats block."""
+        """{tenant: row} plus breaker state — the /stats block. Rows
+        with stream traffic gain ``stream_p99_ms`` computed from the
+        latency reservoir (0.0 until samples arrive)."""
         with self._lock:
             rows = {t: dict(r) for t, r in self._rows.items()}
+            p99 = {
+                t: _percentile(lat, 0.99)
+                for t, lat in self._stream_lat.items()
+                if lat
+            }
         quarantined = set(chaos.quarantined_tenants())
         for t, r in rows.items():
+            if r["stream_chunks"] or t in p99:
+                r["stream_p99_ms"] = p99.get(t, 0.0)
             r["quarantined"] = t in quarantined
             with self._lock:
                 r["strict"] = self._policy.get(t, self.strict_default)
         return rows
+
+
+def _percentile(samples, q: float) -> float:
+    """Nearest-rank percentile over a small reservoir (no numpy: the
+    ledger must stay importable service-side without device deps)."""
+    s = sorted(samples)
+    k = min(len(s) - 1, max(0, int(q * len(s) + 0.5) - 1))
+    return round(float(s[k]), 3)

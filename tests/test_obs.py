@@ -85,7 +85,8 @@ def test_disabled_mode_full_check_allocates_no_rings():
     assert obs_trace.TRACER._rings == {}
     assert obs.trace_stats() == {
         "enabled": False, "events": 0, "spans": 0, "instants": 0,
-        "dropped": 0, "by_kind": {},
+        "dropped": 0, "sample_n": 1, "kinds": None, "sampled_out": 0,
+        "by_kind": {},
     }
 
 
@@ -115,6 +116,57 @@ def test_per_thread_rings_stamp_tid_and_tname():
     by_name = {e["name"]: e for e in obs.spans()}
     assert by_name["from_worker"]["tname"] == "worker-0"
     assert by_name["from_worker"]["tid"] != by_name["from_main"]["tid"]
+
+
+# -- per-kind enable masks + 1-in-N sampling (round 11) ---------------
+
+
+def test_kind_mask_records_only_enabled_kinds():
+    obs.enable(kinds=["dispatch"])
+    obs.instant("keep", kind="dispatch")
+    obs.instant("drop", kind="service")
+    with obs.span("drop_too", kind="launch"):
+        pass
+    names = [e["name"] for e in obs.spans()]
+    assert names == ["keep"]
+    st = obs.trace_stats()
+    assert st["kinds"] == ["dispatch"]
+    # masked-out kinds vanish SILENTLY (never enabled) — they do not
+    # count as sampled_out
+    assert st["sampled_out"] == 0
+
+
+def test_sampling_counts_thinned_emissions_in_ring_metadata():
+    obs.enable(sample_n=4)
+    for i in range(100):
+        obs.instant("tick", kind="soak", i=i)
+    st = obs.trace_stats()
+    assert st["sample_n"] == 4
+    assert st["events"] == 25
+    assert st["sampled_out"] == 75
+    assert st["events"] + st["sampled_out"] == 100
+    # a sampled trace is detectable exactly like a trimmed one:
+    # reset() zeroes the thinning counters with the rings
+    obs_trace.reset()
+    assert obs.trace_stats()["sampled_out"] == 0
+
+
+def test_sampled_out_span_is_the_noop_singleton():
+    # the thinned path reads no clock and allocates no span object
+    obs.enable(kinds=["launch"], sample_n=2)
+    spans = [obs.span("probe", kind="launch") for _ in range(4)]
+    noops = [s for s in spans if s is obs_trace._NOOP]
+    assert len(noops) == 2
+    assert obs.span("masked", kind="service") is obs_trace._NOOP
+
+
+def test_plain_enable_resets_to_full_fidelity():
+    obs.enable(kinds=["dispatch"], sample_n=16)
+    obs.enable()  # the historical record-everything mode
+    assert obs_trace.TRACER.kinds is None
+    assert obs_trace.TRACER.sample_n == 1
+    obs.instant("any", kind="whatever")
+    assert len(obs.spans()) == 1
 
 
 # -- launch-accounting parity (the differential pin) ------------------
@@ -525,6 +577,68 @@ def test_cli_perf_trend_exit_code_contract(tmp_path, capsys):
         "--max-regression", "0.01",
     ]) == EXIT_INVALID
     capsys.readouterr()
+
+
+def test_perf_trend_gates_each_mode_against_its_own_history(
+    tmp_path, capsys
+):
+    """The round-11 gate fix: smoke rows (CPU flow validations) and
+    hardware rows (real measurements) are separate trajectories — a
+    low smoke geomean after a high hardware one is a category error,
+    not a regression, and a real smoke regression must trip the gate
+    even when the hardware trajectory is healthy."""
+    from jepsen_tpu.cli import EXIT_INVALID, EXIT_VALID, main
+    from jepsen_tpu.obs.trend import gate_trend, trend_mode
+
+    base = {"ops_per_sec": 1000.0, "vs_python_oracle": 30.0,
+            "syncs_per_check": 1.0}
+    hw = [dict(base, ts=f"2026-08-0{d}T00:00:00+00:00",
+               vs_baseline=v, mode="hardware", smoke=False)
+          for d, v in ((1, 11.0), (2, 11.2))]
+    # a smoke run landing AFTER the hardware rows: 11.2 -> 2.5 across
+    # modes must NOT read as a drop
+    smoke = [dict(base, ts=f"2026-08-0{d}T01:00:00+00:00",
+                  vs_baseline=v, mode="smoke", smoke=True)
+             for d, v in ((3, 2.5), (4, 2.6))]
+    ledger = tmp_path / "trend.jsonl"
+    ledger.write_text(
+        "".join(json.dumps(r) + "\n" for r in hw + smoke[:1])
+    )
+    assert main(["perf-trend", "--ledger", str(ledger)]) == EXIT_VALID
+    capsys.readouterr()
+
+    # both trajectories healthy -> valid
+    ledger.write_text(
+        "".join(json.dumps(r) + "\n" for r in hw + smoke)
+    )
+    assert main(["perf-trend", "--ledger", str(ledger)]) == EXIT_VALID
+    capsys.readouterr()
+
+    # a regressed SMOKE run trips the gate even though the hardware
+    # trajectory is fine (and vice versa stays caught)
+    bad_smoke = dict(smoke[-1], ts="2026-08-05T01:00:00+00:00",
+                     vs_baseline=1.0)
+    ledger.write_text(
+        "".join(json.dumps(r) + "\n" for r in hw + smoke + [bad_smoke])
+    )
+    assert main(["perf-trend", "--ledger", str(ledger)]) \
+        == EXIT_INVALID
+    out = capsys.readouterr().out
+    assert "smoke: REGRESSION" in out
+    assert "hardware: ok" in out
+
+    # pre-mode legacy rows infer their trajectory from the smoke bool
+    legacy = dict(base, vs_baseline=2.4, smoke=True)
+    legacy.pop("mode", None)
+    assert trend_mode(legacy) == "smoke"
+    assert trend_mode(dict(base, vs_baseline=11.0)) == "hardware"
+    # legacy row joins the smoke trajectory: 2.6 -> 2.4 is a ~7.7%
+    # drop — inside the default 10% budget, outside a tightened 5%
+    ok, _ = gate_trend(hw + smoke + [legacy], 0.1)
+    assert ok
+    ok, msgs = gate_trend(hw + smoke + [legacy], 0.05)
+    assert not ok
+    assert any("smoke: REGRESSION" in m for m in msgs)
 
 
 def test_bench_trend_row_shape_and_append(tmp_path):

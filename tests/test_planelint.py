@@ -371,6 +371,29 @@ def collect(devices):
     return out
 """,
     ),
+    "JT305": (
+        # per-append launch inside a stream loop: every iteration
+        # pays the one-sync launch floor that the plane's stream
+        # bucket would amortize across the whole bucket
+        """
+def drain_stream(stream_appends):
+    verdicts = []
+    for chunk in stream_appends:
+        steps = encode_tail(chunk)
+        verdicts.append(check_steps_bitset_segmented(steps))
+    return verdicts
+""",
+        # sanctioned spelling: tails ride the dispatch plane's stream
+        # bucket and coalesce into stacked launches
+        """
+def drain_stream(plane, stream_appends):
+    futs = []
+    for chunk in stream_appends:
+        steps = encode_tail(chunk)
+        futs.append(plane.submit_stream_tail(steps, None))
+    return [f.result() for f in futs]
+""",
+    ),
     "JT401": (
         # ABBA: two locks nested in conflicting orders across
         # functions — the classic latent deadlock
@@ -565,7 +588,7 @@ def test_rule_catalog_partitions_by_family():
     all_rules = list(analysis.META_RULES) + family_rules
     assert len(all_rules) == len(set(all_rules))
     assert set(all_rules) == set(analysis.RULES)
-    assert analysis.rules_total() == len(analysis.RULES) == 23
+    assert analysis.rules_total() == len(analysis.RULES) == 24
 
 
 def test_host_get_funnel_itself_is_exempt():
@@ -925,7 +948,7 @@ def test_cli_json_contract():
     assert rec["clean"] is True
     assert rec["findings"] == []
     # per-rule descriptions and the catalog size ride the report
-    assert rec["rules_total"] == analysis.rules_total() == 23
+    assert rec["rules_total"] == analysis.rules_total() == 24
     assert set(rec["rules"]) == set(analysis.RULES)
     for meta in rec["rules"].values():
         assert meta["title"] and meta["invariant"]

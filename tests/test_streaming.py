@@ -503,3 +503,327 @@ def test_service_durable_stream_survives_daemon_restart(
         assert d2.ledger.snapshot()["bob"]["durable_resumes"] == 1
     finally:
         _close(d2)
+
+
+# -- coalesced stream tails on one plane (round 11) --------------------
+
+
+def _seq_chunk(r, pairs=8):
+    """One clean-boundary append: sequential write pairs (window 1),
+    identical shape for every stream so concurrent tails share a
+    stream-bucket key and stack into one launch."""
+    ops = []
+    for i in range(pairs):
+        ops.append(invoke_op(0, "write", (r + i) % 3))
+        ops.append(ok_op(0, "write", (r + i) % 3))
+    return ops
+
+
+def _drive_lockstep(scs, chunks, rounds):
+    """Each stream on its own thread, a barrier per round so every
+    tail is submitted before any resolver pumps the plane."""
+    barrier = threading.Barrier(len(scs))
+    errs = []
+
+    def drive(i):
+        try:
+            for r in range(rounds):
+                barrier.wait(timeout=60)
+                scs[i].append(chunks[i][r])
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=drive, args=(i,))
+        for i in range(len(scs))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert errs == []
+
+
+def test_coalesced_tails_stack_into_shared_launches(small_w):
+    """THE round-11 launch-count invariant: k same-shape streams on
+    one plane submit their tails concurrently and the stream bucket
+    stacks them — strictly fewer stacked launches than serial appends
+    (ideally one per lockstep round), with every stream reaching
+    exactly its one-shot verdict."""
+    from jepsen_tpu.checker.dispatch import (
+        DispatchPlane,
+        dispatch_stats,
+        reset_dispatch_stats,
+    )
+
+    n_streams, rounds = 4, 3
+    reset_stream_stats()
+    reset_dispatch_stats()
+    bs.reset_launch_stats()
+    with DispatchPlane(interpret=True) as plane:
+        scs = [
+            StreamingCheck(interpret=True, plane=plane, hold_s=0.4)
+            for _ in range(n_streams)
+        ]
+        chunks = [
+            [_seq_chunk(r) for r in range(rounds)]
+            for _ in range(n_streams)
+        ]
+        _drive_lockstep(scs, chunks, rounds)
+        outs = [sc.result() for sc in scs]
+    total_appends = n_streams * rounds
+    ds = dispatch_stats()
+    assert ds["stream_requests"] == total_appends
+    # coalescing: far fewer stacked launches than appends (perfect
+    # lockstep = one per round; allow a straggler split per round)
+    assert 0 < ds["stream_batches"] < total_appends
+    assert ds["stream_batches"] <= 2 * rounds
+    st = stream_stats()
+    assert st["coalesced_tails"] == total_appends
+    assert st["plane_fallbacks"] == 0
+    for i, out in enumerate(outs):
+        ref = _oneshot(History([op for c in chunks[i] for op in c]))
+        assert _verdict_fields(out) == _verdict_fields(ref)
+        assert out["streaming"]["coalesced"] is True
+
+
+def test_coalesced_tail_death_escalates_to_exact_parity(small_w):
+    """An invalid tail travelling the STACKED path must die at
+    exactly the one-shot op index: the fast-tier death escalates
+    sticky-exact through the plane and the verdict (index included)
+    matches a fresh one-shot check."""
+    from jepsen_tpu.checker.dispatch import (
+        DispatchPlane,
+        reset_dispatch_stats,
+    )
+
+    n_streams, rounds = 2, 2
+    reset_stream_stats()
+    reset_dispatch_stats()
+    with DispatchPlane(interpret=True) as plane:
+        scs = [
+            StreamingCheck(interpret=True, plane=plane, hold_s=0.3)
+            for _ in range(n_streams)
+        ]
+        chunks = [
+            [_seq_chunk(r, pairs=6) for r in range(rounds)]
+            for _ in range(n_streams)
+        ]
+        chunks[-1][-1] = chunks[-1][-1] + _bad_read_tail()
+        _drive_lockstep(scs, chunks, rounds)
+        outs = [sc.result() for sc in scs]
+    assert outs[0]["valid?"] is True
+    ref = _oneshot(History([op for c in chunks[-1] for op in c]))
+    assert ref["valid?"] is False
+    assert _verdict_fields(outs[-1]) == _verdict_fields(ref)
+    assert stream_stats()["escalations"] >= 1
+
+
+# -- windowed frontier GC (round 11) -----------------------------------
+
+
+def test_stream_gc_bounds_retained_ops(small_w):
+    """Bounded memory: with gc_window set, a long stream's host-side
+    op retention stays O(window) while the archive and the global
+    checked count keep growing — and the verdict stays valid."""
+    reset_stream_stats()
+    gc_window = 64
+    sc = StreamingCheck(interpret=True, gc_window=gc_window)
+    total = 0
+    retained_max = 0
+    for r in range(30):
+        chunk = _seq_chunk(r, pairs=8)
+        sc.append(chunk)
+        total += len(chunk)
+        retained_max = max(retained_max, len(sc._ops))
+    out = sc.result()
+    assert out["valid?"] is True
+    s = sc.summary()
+    assert s["gc_sealed_ops"] > 0
+    assert s["retained_ops"] + s["gc_sealed_ops"] == total
+    # the bound: never more than the window plus one in-flight chunk
+    assert retained_max <= gc_window + 16, (retained_max, total)
+    assert retained_max < total
+    res = sc.device_residency()
+    assert res["archived_ops"] == s["gc_sealed_ops"]
+    assert stream_stats()["gc_seals"] >= 1
+    assert stream_stats()["gc_ops_archived"] == s["gc_sealed_ops"]
+
+
+def test_stream_gc_invalidation_reruns_from_step_zero_exactly(small_w):
+    """Invalidation exactness across a GC seal: a W-widening burst
+    dissolves the sealed frame (the archive restores, the whole
+    stream re-checks from step 0), and a subsequent bad tail dies at
+    the GLOBAL one-shot op index — archival must not shift or blur
+    failure attribution."""
+    reset_stream_stats()
+    sc = StreamingCheck(interpret=True, gc_window=64)
+    ops_all = []
+    for r in range(20):
+        chunk = _seq_chunk(r, pairs=8)
+        sc.append(chunk)
+        ops_all += chunk
+    assert sc.summary()["gc_sealed_ops"] > 0
+    # widen the window past the sealed prefix's W bucket: the
+    # envelope changes, so the GC frame must dissolve and re-form
+    burst = [invoke_op(p, "write", p % 3) for p in range(6)]
+    burst += [ok_op(p, "write", p % 3) for p in range(6)]
+    sc.append(burst)
+    ops_all += burst
+    bad = _bad_read_tail()
+    sc.append(bad)
+    ops_all += bad
+    out = sc.result()
+    ref = _oneshot(History(ops_all))
+    assert ref["valid?"] is False
+    assert _verdict_fields(out) == _verdict_fields(ref)
+    assert stream_stats()["invalidations"] >= 1
+
+
+# -- persistence batching (round 11) -----------------------------------
+
+
+def test_persist_batching_amortizes_saves_and_resumes(
+    tmp_path, small_w, monkeypatch
+):
+    """persist_every=N batches the fsync: N-1 of every N verified
+    appends skip _save, and a crash between boundaries resumes from
+    the (possibly stale) last save to the SAME verdict as a fresh
+    one-shot — the replayed suffix re-checks, nothing is lost."""
+    path = str(tmp_path / "stream.json")
+    saves = []
+    orig = StreamingCheck._save
+
+    def counting_save(self):
+        saves.append(1)
+        return orig(self)
+
+    monkeypatch.setattr(StreamingCheck, "_save", counting_save)
+    chunks = [_seq_chunk(r, pairs=4) for r in range(6)]
+    sc = StreamingCheck(interpret=True, path=path, persist_every=4)
+    for c in chunks[:5]:
+        sc.append(c)
+    # 5 verified appends at every=4 -> exactly ONE durable boundary
+    assert len(saves) == 1
+    del sc  # crash: one append of dirty state never persisted
+    reset_stream_stats()
+    all_ops = [op for c in chunks for op in c]
+    sc2 = StreamingCheck(interpret=True, path=path, persist_every=4)
+    sc2.append(all_ops)  # client replays from the start
+    out = sc2.result()
+    assert sc2.resumed is True
+    assert stream_stats()["resumes"] == 1
+    assert _verdict_fields(out) == _verdict_fields(
+        _oneshot(History(all_ops))
+    )
+
+
+# -- 1k-stream daemon soak (slow tier) ---------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.service
+def test_service_1k_stream_soak(tmp_path, small_w):
+    """Production-rate shape: 1000 concurrent streams POST chunks at
+    one daemon in lockstep rounds; the plane's stream bucket keeps
+    stacked launches near ceil(appends / max_batch) per round, every
+    stream reaches a valid final verdict inside its deadline, and the
+    tenant ledger accounts every chunk with a p99."""
+    from jepsen_tpu.checker.dispatch import (
+        dispatch_stats,
+        reset_dispatch_stats,
+    )
+
+    n_streams, rounds = 1000, 2
+    d = _daemon(tmp_path, coalesce_hold_s=1.0)
+    try:
+        bucket_size = d.plane.max_batch
+        reset_stream_stats()
+        reset_dispatch_stats()
+        chunk_rounds = [_seq_chunk(r, pairs=2) for r in range(rounds)]
+        barrier = threading.Barrier(n_streams)
+        errs = []
+        finals = [None] * n_streams
+
+        def drive(i):
+            try:
+                for r in range(rounds):
+                    barrier.wait(timeout=300)
+                    final = r == rounds - 1
+                    code, out = d.handle_stream("soak", _chunk(
+                        f"s{i}", chunk_rounds[r], final=final,
+                        deadline_s=240.0,
+                    ))
+                    assert code == (200 if final else 202), out
+                    if final:
+                        finals[i] = out
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=drive, args=(i,))
+            for i in range(n_streams)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        assert errs == []
+        assert all(
+            out is not None and out["valid?"] is True
+            for out in finals
+        )
+        total_appends = n_streams * rounds
+        ds = dispatch_stats()
+        assert ds["stream_requests"] == total_appends
+        per_round = -(-n_streams // bucket_size)  # ceil
+        assert ds["stream_batches"] <= 2 * per_round * rounds
+        row = d.ledger.snapshot()["soak"]
+        assert row["stream_chunks"] == total_appends
+        assert row["stream_p99_ms"] >= 0.0
+        assert row["stream_deadline_misses"] == 0
+    finally:
+        _close(d)
+
+
+@pytest.mark.service
+def test_service_stream_deadline_slo_accounting(tmp_path, small_w):
+    """Per-append SLO: an over-budget chunk still answers (the
+    verdict is already computed) but strikes stream_deadline_misses
+    and flags the response; every chunk's wall feeds the tenant's
+    stream_p99_ms reservoir, and both rows ride /stats and /metrics
+    like any other ledger counter."""
+    from jepsen_tpu.obs.prom import prometheus_text
+
+    h = burst_history()
+    ops = list(h.ops)
+    d = _daemon(tmp_path)
+    try:
+        # generous budget: no miss, no flag
+        code, out = d.handle_stream(
+            "carol", _chunk("s1", ops[:20], deadline_s=120.0)
+        )
+        assert code == 202 and "deadline_miss" not in out
+        # impossible budget: answered anyway, flagged + struck
+        code, out = d.handle_stream(
+            "carol",
+            _chunk("s1", ops[20:], final=True, deadline_s=1e-9),
+        )
+        assert code == 200
+        assert out["deadline_miss"] is True
+        assert out["valid?"] is True
+        row = d.ledger.snapshot()["carol"]
+        assert row["stream_chunks"] == 2
+        assert row["stream_deadline_misses"] == 1
+        assert row["stream_p99_ms"] > 0.0
+        body = prometheus_text(
+            snapshot={}, events=[], tenants=d.ledger.snapshot()
+        )
+        assert (
+            'jepsen_tpu_tenant_stream_deadline_misses'
+            '{tenant="carol"} 1' in body
+        )
+        assert 'jepsen_tpu_tenant_stream_p99_ms{tenant="carol"}' \
+            in body
+    finally:
+        _close(d)
