@@ -110,10 +110,15 @@ TREND_LEDGER_PATH = "bench_runs/trend.jsonl"
 def trend_row_from_record(record: dict, *, ts=None, smoke=None) -> dict:
     """The compact per-run trend row: exactly the columns cli
     perf-trend renders and gates on, pulled from the bench's final
-    JSON record."""
+    JSON record — plus the perf plane's config identity (config_hash,
+    tuned flag, resolved knob values) so perf-trend can split a
+    vs_baseline drop into config drift vs code drift."""
     import datetime
 
+    from jepsen_tpu.perf import knobs as perf_knobs
+
     residency = record.get("residency") or {}
+    perf = perf_knobs.perf_snapshot()
     return {
         "ts": ts or datetime.datetime.now(
             datetime.timezone.utc
@@ -139,6 +144,16 @@ def trend_row_from_record(record: dict, *, ts=None, smoke=None) -> dict:
             "smoke" if (SMOKE if smoke is None else smoke)
             else "hardware"
         ),
+        # the knob-config identity this run measured under: the 12-hex
+        # hash of the full resolved registry config, whether a
+        # persisted tuned profile supplied it, and the resolved values
+        # themselves (ladders as lists) for forensic diffing.
+        "config_hash": perf["config_hash"],
+        "tuned": perf["tuned"],
+        "knobs": {
+            k: (list(v) if isinstance(v, tuple) else v)
+            for k, v in sorted(perf_knobs.active_config().items())
+        },
     }
 
 
@@ -1834,10 +1849,10 @@ def main() -> None:
         # all five families (incl. D lockorder / E determinism) must
         # be active before the number is publishable.
         _rules_total = analysis.rules_total()
-        if _rules_total < 24:
+        if _rules_total < 25:
             raise SystemExit(
                 f"bench: planelint catalog shrank to {_rules_total} "
-                "rules (< 24): a family is disabled; refusing to "
+                "rules (< 25): a family is disabled; refusing to "
                 "publish"
             )
         print(
@@ -1874,18 +1889,16 @@ def main() -> None:
 
     # Persistent compilation cache: the bench runs in a fresh process
     # each round; cached executables shave minutes of XLA/Mosaic
-    # recompiles off every run after the first. Per-user path — a
-    # shared world-writable /tmp dir could be pre-created (and its
-    # serialized executables poisoned) by another local user.
+    # recompiles off every run after the first. Same per-user path the
+    # cli/daemon/pod entry points use (perf.autotune owns it) — the
+    # perf-profile store lives beside it.
     import os
 
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(
-            os.path.expanduser("~"), ".cache", "jepsen_tpu",
-            "jax_cache",
-        ),
+    from jepsen_tpu.perf.autotune import (
+        enable_persistent_compile_cache,
     )
+
+    enable_persistent_compile_cache()
 
     import jax
 

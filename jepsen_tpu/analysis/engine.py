@@ -150,6 +150,11 @@ RULES: Dict[str, Tuple[str, str]] = {
         "jit cache-key hazard",
         "jitted functions must not key their cache on mutable state",
     ),
+    "JT107": (
+        "raw tunable read",
+        "perf-registry knobs resolve through jepsen_tpu.perf.knobs, "
+        "never as raw module constants in hot paths",
+    ),
     "JT201": (
         "stats mutation outside lock",
         "every *_STATS mutation happens under its declared lock",
@@ -240,7 +245,8 @@ META_RULES: Tuple[str, ...] = ("JT000", "JT001")
 
 #: family letter -> its rule ids (the catalog partition)
 FAMILY_RULES: Dict[str, Tuple[str, ...]] = {
-    "A": ("JT101", "JT102", "JT103", "JT104", "JT105", "JT106"),
+    "A": ("JT101", "JT102", "JT103", "JT104", "JT105", "JT106",
+          "JT107"),
     "B": ("JT201", "JT202", "JT203", "JT204", "JT205"),
     "C": ("JT301", "JT302", "JT303", "JT304", "JT305"),
     "D": ("JT401", "JT402", "JT403"),

@@ -24,6 +24,14 @@ Rules:
   position and then read again in the same block.
 - JT106 jit-cache-key hazards: mutable default args on jitted
   functions; jitted bodies closing over mutable module globals.
+- JT107 raw tunable read: a perf-registry knob's module constant
+  (W_BUCKETS, GRAPH_BUCKETS, ...) read directly inside a function
+  body instead of resolving through ``jepsen_tpu.perf.knobs`` — a
+  persisted tuned profile could never retune that path. Module-level
+  reads and signature defaults (evaluated at def time) are the
+  sanctioned "document the registry default" spellings, and a
+  function that itself calls ``resolve()`` is a resolution site
+  (the raw constant is its registry-miss fallback).
 """
 
 from __future__ import annotations
@@ -63,6 +71,29 @@ _GUARDS = {"resilient_call", "run_with_deadline", "_guard", "guard"}
 _ACCOUNTING = {"_bump_launch", "note_sharded_launch"}
 #: factory prefixes returning device callables
 _FACTORY_PREFIXES = ("make_sharded_",)
+
+#: fallback catalog for JT107 when the registry itself won't import
+#: (linting a tree mid-refactor must not crash the lint)
+_KNOB_CONST_FALLBACK = frozenset({
+    "W_BUCKETS", "ROWS_BUCKET_GROWTH", "GRAPH_BUCKETS",
+    "PACKED_WORD_MAX_N", "STREAM_TAIL_BUCKET",
+})
+
+
+def _registry_constants() -> Set[str]:
+    """Module-constant names the perf-knob registry supersedes
+    (knobs with ``const=None`` have no raw-constant spelling to
+    misread). perf/knobs.py is pure stdlib, so the lint reads the
+    registry directly and can never drift from it."""
+    try:
+        from jepsen_tpu.perf import knobs as _perf_knobs
+
+        consts = {
+            k.const for k in _perf_knobs.KNOBS.values() if k.const
+        }
+        return consts or set(_KNOB_CONST_FALLBACK)
+    except Exception:
+        return set(_KNOB_CONST_FALLBACK)
 
 
 def _is_jit_wrapper_call(call: ast.Call) -> Optional[ast.Call]:
@@ -819,6 +850,7 @@ class HotPathChecker:
                             sub, f"{node.name}.{sub.name}"
                         )
         self._jit_cache_hazards()
+        self._knob_const_reads()
         return self.findings
 
     def _function(self, fn: ast.FunctionDef, symbol: str) -> None:
@@ -888,6 +920,72 @@ class HotPathChecker:
                             node.name,
                             severity="warning",
                         )
+
+
+    def _knob_const_reads(self) -> None:
+        """JT107: a perf-registry tunable read as a raw module
+        constant inside a function body. Module-level reads and
+        signature defaults evaluate at def time and are the sanctioned
+        way to publish the registry default; a function that itself
+        resolves through the registry is a resolution site, where the
+        raw constant is the legitimate registry-miss fallback. One
+        finding per (function, constant)."""
+        consts = _registry_constants()
+        if not consts:
+            return
+        targets: List[Tuple[ast.FunctionDef, str]] = []
+        for node in self.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                targets.append((node, node.name))
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        targets.append(
+                            (sub, f"{node.name}.{sub.name}")
+                        )
+        for fn, symbol in targets:
+            self._knob_reads_in(fn, symbol, consts)
+
+    def _knob_reads_in(
+        self, fn: ast.FunctionDef, symbol: str, consts: Set[str]
+    ) -> None:
+        skip: Set[int] = set()  # nodes inside nested-def defaults
+        resolves = False
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defaults = list(sub.args.defaults) + [
+                    d for d in sub.args.kw_defaults if d is not None
+                ]
+                for d in defaults:
+                    for n in ast.walk(d):
+                        skip.add(id(n))
+            elif isinstance(sub, ast.Call):
+                if _last_seg(sub.func) == "resolve":
+                    resolves = True
+        if resolves:
+            return
+        seen: Set[str] = set()
+        for stmt in fn.body:
+            for sub in ast.walk(stmt):
+                if id(sub) in skip:
+                    continue
+                if (
+                    isinstance(sub, ast.Name)
+                    and isinstance(sub.ctx, ast.Load)
+                    and sub.id in consts
+                    and sub.id not in seen
+                ):
+                    seen.add(sub.id)
+                    self.add(
+                        "JT107", sub,
+                        f"'{symbol}' reads tunable '{sub.id}' as a "
+                        "raw module constant — registry knobs resolve "
+                        "through jepsen_tpu.perf.knobs (a persisted "
+                        "profile retunes them; the constant is only "
+                        "the registry default)",
+                        symbol,
+                        severity="warning",
+                    )
 
 
 def check_hotpath(tree: ast.Module, rel: str) -> List[Finding]:

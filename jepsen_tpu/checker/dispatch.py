@@ -109,6 +109,13 @@ from jepsen_tpu.checker.linearizable import (
 )
 from jepsen_tpu.checker.models import model as get_model
 from jepsen_tpu.obs import trace as obs_trace
+from jepsen_tpu.perf import knobs as _perf_knobs
+
+#: length-bucket quantum for coalescing stream tails into one stacked
+#: launch (submit_stream_tail). Documented default; the plane resolves
+#: the live value through the perf knob registry at construction
+#: ("streaming.tail_len_bucket").
+STREAM_TAIL_BUCKET = 64
 
 #: plane-level dispatch accounting (launch-level counts live in
 #: wgl_bitset.LAUNCH_STATS): "requests" = submissions accepted,
@@ -408,10 +415,14 @@ class DispatchPlane:
       race: start the native-oracle competition racer for eligible
         requests (off by default: the plane is primarily a throughput
         surface, and the sequential default races only on real TPUs).
-      max_batch: occupancy at which a bucket flushes without waiting.
+      max_batch: occupancy at which a bucket flushes without waiting
+        (None = resolve "dispatch.max_batch" through the perf knob
+        registry: the persisted per-backend profile when one is
+        loaded, the registry default otherwise).
       coalesce_wait_us: how long a bucket may wait for partners before
         an age-based flush (async_prep mode; synchronous callers flush
-        explicitly or at result()).
+        explicitly or at result()). None resolves
+        "dispatch.coalesce_hold_s" (seconds) the same way.
       async_prep: run prep + flush on a worker thread, overlapping host
         prep of request N+1 with device execution of request N.
       mesh: the execution mesh (sharded.resolve_mesh semantics: None =
@@ -444,29 +455,49 @@ class DispatchPlane:
         model: str = "cas-register",
         interpret: bool = False,
         race: bool = False,
-        max_batch: int = 256,
-        coalesce_wait_us: float = 2000.0,
+        max_batch: Optional[int] = None,
+        coalesce_wait_us: Optional[float] = None,
         async_prep: bool = False,
         mesh=None,
         retry: Optional[chaos.RetryPolicy] = None,
         launch_deadline_s: Optional[float] = None,
         quarantine_after: int = 3,
         worker_join_s: float = 10.0,
-        max_inflight_trains: int = 2,
+        max_inflight_trains: Optional[int] = None,
         host_domain_quarantine: bool = True,
     ):
         from jepsen_tpu.checker.sharded import resolve_mesh
 
+        # perf-plane consult: explicit kwargs win; unspecified knobs
+        # resolve through the persisted per-backend profile (registry
+        # defaults when none is loaded).
+        _perf_knobs.ensure_profile()
         self.model = model
         self.interpret = interpret
         self.race = race
-        self.max_batch = max_batch
+        self.max_batch = int(
+            max_batch if max_batch is not None
+            else _perf_knobs.resolve("dispatch.max_batch")
+        )
+        if coalesce_wait_us is None:
+            coalesce_wait_us = 1e6 * float(
+                _perf_knobs.resolve("dispatch.coalesce_hold_s")
+            )
         self.coalesce_wait_s = coalesce_wait_us / 1e6
         #: double-buffered collect trains: at most this many unresolved
         #: launches in flight; registering one more collects the oldest
         #: first (its device->host copy started at registration, so
         #: that collect overlaps the newer train's device execution).
-        self.max_inflight_trains = max(int(max_inflight_trains), 1)
+        self.max_inflight_trains = max(int(
+            max_inflight_trains if max_inflight_trains is not None
+            else _perf_knobs.resolve("dispatch.max_inflight_trains")
+        ), 1)
+        #: stream-tail coalescing quantum (STREAM_TAIL_BUCKET default)
+        self._tail_bucket = max(int(
+            _perf_knobs.resolve(
+                "streaming.tail_len_bucket", STREAM_TAIL_BUCKET
+            )
+        ), 1)
         self.retry = retry or chaos.DEFAULT_RETRY
         self.launch_deadline_s = launch_deadline_s
         self.quarantine_after = quarantine_after
@@ -628,7 +659,7 @@ class DispatchPlane:
         fut.frontier = frontier
         fut.S = S
         fut.W = steps.W
-        n = bucket(max(len(steps), 1), 64)
+        n = bucket(max(len(steps), 1), self._tail_bucket)
         fut.key = (
             "stream", name, S, steps.W, n, self.interpret, bool(exact)
         )
@@ -1840,7 +1871,9 @@ def default_plane(**kw) -> DispatchPlane:
     the plane ONLY on first construction (the service daemon owns the
     process and configures interpret/deadline/retry up front); later
     callers get the existing plane unchanged — call
-    reset_default_plane() first to reconfigure."""
+    reset_default_plane() first to reconfigure. Construction consults
+    the persisted perf profile (perf.knobs.ensure_profile) for every
+    knob not pinned by a kwarg."""
     global _DEFAULT_PLANE
     with _default_lock:
         if _DEFAULT_PLANE is None:

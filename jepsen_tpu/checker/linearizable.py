@@ -900,6 +900,13 @@ class LinearizableChecker:
         sentry: bool = True,
         strict_history: bool = False,
     ):
+        # perf-plane consult: load the persisted per-backend profile
+        # (once per process) so plan-time knob resolution — the bitset
+        # W rung ladder, the rows-bucket quantum — sees it. No-op on
+        # the common no-profile path.
+        from jepsen_tpu.perf import knobs as _perf_knobs
+
+        _perf_knobs.ensure_profile()
         self.model = model
         self.init_value = init_value
         self.use_tpu = use_tpu

@@ -701,7 +701,8 @@ def row_spec(mesh: Mesh) -> P:
 
 @functools.lru_cache(maxsize=None)
 def make_sharded_graph(mesh: Mesh, n_iters: int, need1: bool,
-                       need2: bool):
+                       need2: bool,
+                       packed_max: int = 32):
     """Batch-axis sharded repeated-squaring cycle kernel: [B, N, N]
     adjacency stacks split over the mesh on the batch axis (graphs are
     independent components, so the per-shard closure is collective-free
@@ -711,7 +712,8 @@ def make_sharded_graph(mesh: Mesh, n_iters: int, need1: bool,
     def per_shard(wrww, allm, rw):
         from jepsen_tpu.checker.txn_graph import _graph_counts_body
 
-        return _graph_counts_body(wrww, allm, rw, n_iters, need1, need2)
+        return _graph_counts_body(wrww, allm, rw, n_iters, need1,
+                                  need2, packed_max)
 
     try:
         sharded = _shard_map(

@@ -231,7 +231,17 @@ class StreamingCheck:
     between boundaries resumes from the last persisted frontier).
     gc_window: seal + archive the checked prefix past this many ops at
     clean boundaries (module docstring) — None disables GC.
+
+    persist_every and gc_window left unspecified resolve through the
+    perf knob registry ("streaming.persist_every" /
+    "streaming.gc_window", where 0 = GC off): the persisted
+    per-backend profile's choice when one is loaded, the registry
+    defaults otherwise. Explicit arguments always win.
     """
+
+    #: "resolve through the perf knob registry" sentinel (None is a
+    #: meaningful gc_window value: GC off)
+    _KNOB = object()
 
     def __init__(
         self,
@@ -241,10 +251,22 @@ class StreamingCheck:
         path: Optional[str] = None,
         plane=None,
         hold_s: float = 0.0,
-        persist_every: int = 1,
-        gc_window: Optional[int] = None,
+        persist_every=_KNOB,
+        gc_window=_KNOB,
     ):
         import os
+
+        from jepsen_tpu.perf import knobs as _perf_knobs
+
+        _perf_knobs.ensure_profile()
+        if persist_every is StreamingCheck._KNOB:
+            persist_every = int(
+                _perf_knobs.resolve("streaming.persist_every")
+            )
+        if gc_window is StreamingCheck._KNOB:
+            gc_window = (
+                int(_perf_knobs.resolve("streaming.gc_window")) or None
+            )
 
         if path is not None and os.path.isdir(path):
             path = os.path.join(path, "stream.json")

@@ -75,6 +75,23 @@ _SUBMIT_ELEMS = 1 << 23
 #: largest single-graph (oversize component) launch without a mesh
 _SOLO_MAX_N = 8192
 
+#: largest component N that takes the packed-uint32 closure (rows
+#: packed into machine words, word-parallel OR-gather) instead of the
+#: batched f32 einsum. Documented default; the live value resolves
+#: through the perf knob registry ("txn_graph.packed_word_max_n") and
+#: is clamped to 32 — a uint32 has 32 lanes.
+PACKED_WORD_MAX_N = 32
+
+
+def _packed_word_max_n() -> int:
+    from jepsen_tpu.perf import knobs as _perf_knobs
+
+    return max(1, min(32, int(
+        _perf_knobs.resolve(
+            "txn_graph.packed_word_max_n", PACKED_WORD_MAX_N
+        )
+    )))
+
 TXN_GRAPH_STATS = {
     "encodes": 0,            # histories lowered to columnar planes
     "extracts": 0,           # vectorized edge extractions
@@ -1108,18 +1125,23 @@ def _n_iters(n: int) -> int:
 
 
 def _graph_counts_body(wrww, allm, rw, n_iters: int, need1: bool,
-                       need2: bool):
+                       need2: bool, packed_max: int = PACKED_WORD_MAX_N):
     """Traceable kernel body shared by the solo jit and the sharded
     batch closure: boolean reachability by repeated squaring and the
     three per-anomaly masks. Returns per-graph int32 counts only — the
     whole launch costs one tiny host transfer.
 
-    Two inner products for the same recurrence R = R | R @ R:
-      - N <= 32: rows packed into machine words (the wgl_bitset idiom)
-        so one squaring round is a word-parallel OR-gather — small
-        components dominate real histories and batched 12x12 f32
-        matmuls waste most of their lanes on padding;
-      - N > 32: batched f32 einsum (min(R + R @ R, 1)) -> MXU."""
+    Two inner products for the same recurrence R = R | R @ R, split
+    at ``packed_max`` (the "txn_graph.packed_word_max_n" knob, <= 32):
+      - N <= packed_max: rows packed into machine words (the
+        wgl_bitset idiom) so one squaring round is a word-parallel
+        OR-gather — small components dominate real histories and
+        batched 12x12 f32 matmuls waste most of their lanes on
+        padding;
+      - N > packed_max: batched f32 einsum (min(R + R @ R, 1)) ->
+        MXU. ``packed_max`` is part of every jit cache key upstream
+        (_graph_kernel, sharded.make_sharded_graph) — a profile swap
+        can never reuse a kernel traced under the other closure."""
     import jax
     import jax.numpy as jnp
 
@@ -1129,7 +1151,7 @@ def _graph_counts_body(wrww, allm, rw, n_iters: int, need1: bool,
     rwb = rw > 0
     g1c = gs = g2 = z
 
-    if N <= 32:
+    if N <= packed_max:
         lanes = jnp.arange(N, dtype=jnp.uint32)
         pw = jnp.uint32(1) << lanes
 
@@ -1189,11 +1211,13 @@ def _graph_counts_body(wrww, allm, rw, n_iters: int, need1: bool,
 
 
 @functools.lru_cache(maxsize=None)
-def _graph_kernel(n_iters: int, need1: bool, need2: bool):
+def _graph_kernel(n_iters: int, need1: bool, need2: bool,
+                  packed_max: int):
     import jax
 
     def fn(wrww, allm, rw):
-        return _graph_counts_body(wrww, allm, rw, n_iters, need1, need2)
+        return _graph_counts_body(wrww, allm, rw, n_iters, need1,
+                                  need2, packed_max)
 
     return jax.jit(fn)
 
@@ -1209,6 +1233,7 @@ def launch_graph_batch(wrww, allm, rw, need1: bool = True,
 
     B, N = int(wrww.shape[0]), int(wrww.shape[-1])
     n_iters = _n_iters(N)
+    packed_max = _packed_word_max_n()
     _note("matmul_rounds", n_iters * (int(need1) + int(need2)))
     _note("device_graphs", B)
     obs_trace.instant("graph_batch", kind="txn_graph", graphs=B, n=N,
@@ -1232,12 +1257,13 @@ def launch_graph_batch(wrww, allm, rw, need1: bool = True,
             spec = NamedSharding(mesh, sh.key_spec(mesh))
             args = [jax.device_put(np.asarray(x), spec)
                     for x in (wrww, allm, rw)]
-            fn = sh.make_sharded_graph(mesh, n_iters, need1, need2)
+            fn = sh.make_sharded_graph(mesh, n_iters, need1, need2,
+                                       packed_max)
             out = fn(*args)
             sh.note_sharded_launch(nd)
             bs._bump_launch("launches")
             return out
-    out = _graph_kernel(n_iters, need1, need2)(
+    out = _graph_kernel(n_iters, need1, need2, packed_max)(
         jnp.asarray(wrww), jnp.asarray(allm), jnp.asarray(rw))
     bs._bump_launch("launches")
     return out
@@ -1382,8 +1408,18 @@ class TxnGraphChecker:
         plane=None,
         mesh=None,
         oracle: bool = False,
-        buckets: Sequence[int] = GRAPH_BUCKETS,
+        buckets: Optional[Sequence[int]] = None,
     ):
+        if buckets is None:
+            # perf-plane consult: the persisted per-backend profile's
+            # ladder ("txn_graph.graph_buckets") when one is loaded,
+            # the GRAPH_BUCKETS default otherwise
+            from jepsen_tpu.perf import knobs as _perf_knobs
+
+            _perf_knobs.ensure_profile()
+            buckets = _perf_knobs.resolve(
+                "txn_graph.graph_buckets", GRAPH_BUCKETS
+            )
         bad = set(classes) - set(ANOMALIES)
         if bad:
             raise ValueError(f"unknown anomaly classes: {sorted(bad)}")

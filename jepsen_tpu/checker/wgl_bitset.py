@@ -128,6 +128,23 @@ MIN_WORDS = 128
 #: (>10 min), so windows past 19 route to the K-frontier ladder.
 W_BUCKETS = (12, 13, 14, 15, 16, 17, 18, 19)
 
+#: state-row (S) padding quantum (documented default; live value
+#: resolves through the perf knob registry, "wgl_bitset.
+#: rows_bucket_growth")
+ROWS_BUCKET_GROWTH = 8
+
+
+def _w_buckets() -> tuple:
+    """The active W rung ladder ("wgl_bitset.w_buckets"): the
+    persisted per-backend profile's choice when one is loaded, the
+    live W_BUCKETS module constant otherwise (so tests that prepend
+    narrow rungs keep working). Every ladder the registry admits tops
+    out at 19 (the Mosaic compile ceiling), so the envelope gate's
+    semantics never move — only which rungs get compiled."""
+    from jepsen_tpu.perf import knobs as _perf_knobs
+
+    return tuple(_perf_knobs.resolve("wgl_bitset.w_buckets", W_BUCKETS))
+
 #: state-row cap (VMEM: 32 x 2048 x 4 B = 256 KB at W=16)
 MAX_ROWS = 32
 
@@ -144,14 +161,24 @@ _C1 = tuple(
 
 
 def w_bucket(window: int) -> int | None:
-    for w in W_BUCKETS:
+    for w in _w_buckets():
         if window <= w:
             return w
     return None
 
 
 def _rows_bucket(rows: int) -> int:
-    return max(8, bucket(rows, 8))
+    from jepsen_tpu.perf import knobs as _perf_knobs
+
+    g = max(
+        int(
+            _perf_knobs.resolve(
+                "wgl_bitset.rows_bucket_growth", ROWS_BUCKET_GROWTH
+            )
+        ),
+        1,
+    )
+    return max(g, bucket(rows, g))
 
 
 def plan(m, window: int, n_value_codes: int) -> Tuple[int, int] | None:
@@ -738,8 +765,9 @@ def required_buckets(steps: ReturnSteps) -> np.ndarray:
         occ.any(axis=1), Wf - 1 - np.argmax(occ[:, ::-1], axis=1), -1
     )
     need = np.maximum(maxslot, steps.slot) + 1
-    wreq = np.full(n, W_BUCKETS[-1], np.int64)
-    for b in reversed(W_BUCKETS):
+    wb = _w_buckets()
+    wreq = np.full(n, wb[-1], np.int64)
+    for b in reversed(wb):
         wreq[need <= b] = b
     return wreq
 
@@ -763,7 +791,8 @@ def plan_segments(
     the boundary because no occupied slot reaches the sliced-off
     lanes (see _reshape_frontier)."""
     n = len(steps)
-    if n == 0 or steps.W <= W_BUCKETS[0]:
+    wb = _w_buckets()
+    if n == 0 or steps.W <= wb[0]:
         return [(0, n, steps.W)]
     if min_len is None:
         # every launch costs host dispatch; bound the segment count
@@ -776,7 +805,7 @@ def plan_segments(
     # width spike widens only its own chunk.
     chunk = max(min_len // 2, STEP_BLOCK)
     n_chunks = (n + chunk - 1) // chunk
-    padded = np.full(n_chunks * chunk, W_BUCKETS[0], wreq.dtype)
+    padded = np.full(n_chunks * chunk, wb[0], wreq.dtype)
     padded[:n] = wreq
     cmax = padded.reshape(n_chunks, chunk).max(axis=1)
     runs: List[List[int]] = []

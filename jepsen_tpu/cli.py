@@ -233,6 +233,29 @@ def _resolve_run_dir(path: str, store_root: str) -> str:
     return latest
 
 
+def _perf_setup(args) -> None:
+    """Perf-plane session setup shared by the single-process entry
+    points (analyze, daemon): turn on the persistent XLA compile cache
+    (pod children already inherit it via launcher.pod_env) and honor an
+    explicit ``--profile PATH``. The explicit path is strict-ish: a
+    profile the user NAMED that fails to load gets a warning (silent
+    fallback is only for the ambient auto-discovered store)."""
+    from jepsen_tpu.perf import autotune
+
+    autotune.enable_persistent_compile_cache()
+    prof = getattr(args, "profile", None)
+    if prof:
+        import os
+
+        os.environ[autotune.PROFILE_ENV] = prof
+        if autotune.load_active_profile() is None:
+            print(
+                f"perf: profile {prof} is invalid, foreign, or stale; "
+                "using defaults",
+                file=sys.stderr,
+            )
+
+
 def cmd_analyze(args) -> int:
     """`analyze`, with the flight recorder wrapped around it when
     --trace PATH is given: the tracer enables before any launch,
@@ -342,6 +365,7 @@ def _cmd_analyze(args) -> int:
     )
     from jepsen_tpu.store import Store
 
+    _perf_setup(args)
     _reset_engine_state()
     _apply_mesh_args(args)
     run_dir = _resolve_run_dir(args.path, args.store)
@@ -615,12 +639,22 @@ def cmd_perf_trend(args) -> int:
         v = row.get(key)
         return f"{v:.3f}" if isinstance(v, (int, float)) else "-"
 
-    print(f"{'ts':<20} {'mode':<8} {'vs_base':>8} {'vs_py':>10} "
-          f"{'syncs':>6} {'floor_ms':>9} {'occup':>6} "
+    def _cfg(row):
+        """Short knob-config identity: rows before the schema gained
+        config_hash render '-'; a '*' marks a persisted tuned profile
+        (vs. registry defaults)."""
+        h = row.get("config_hash")
+        if not isinstance(h, str) or not h:
+            return "-"
+        return h[:8] + ("*" if row.get("tuned") else "")
+
+    print(f"{'ts':<20} {'mode':<8} {'cfg':<9} {'vs_base':>8} "
+          f"{'vs_py':>10} {'syncs':>6} {'floor_ms':>9} {'occup':>6} "
           f"{'trace_ov%':>9} {'ops/s':>10}")
     for r in rows:
         ts = str(r.get("ts", "?"))[:19]
         print(f"{ts:<20} {trend_mode(r):<8} "
+              f"{_cfg(r):<9} "
               f"{_num(r, 'vs_baseline'):>8} "
               f"{_num(r, 'vs_python_oracle'):>10} "
               f"{_num(r, 'syncs_per_check'):>6} "
@@ -632,6 +666,30 @@ def cmd_perf_trend(args) -> int:
     for m in msgs:
         print(f"perf-trend: {m}")
     return EXIT_VALID if ok else EXIT_INVALID
+
+
+def cmd_tune(args) -> int:
+    """`tune`: sweep the perf-knob registry on THIS backend and
+    persist the winning overrides as a per-(backend, device-count,
+    jax-version) profile beside the compile cache. Every candidate
+    rung must reproduce the baseline probe verdict (verdict parity) or
+    it is rejected regardless of speed; sweep evidence lands in a
+    sibling .evidence.json. Exit 0 when a profile was written (or
+    --dry-run completed), 1 when nothing persistable came out of the
+    budget, 255 on an unknown --knobs name."""
+    from jepsen_tpu.perf import autotune
+
+    autotune.enable_persistent_compile_cache()
+    only = None
+    if args.knobs:
+        only = [k.strip() for k in args.knobs.split(",") if k.strip()]
+    try:
+        return autotune.run_tune(
+            budget_s=args.budget_s, only=only, dry_run=args.dry_run
+        )
+    except ValueError as e:
+        print(f"tune: {e}", file=sys.stderr)
+        return EXIT_USAGE
 
 
 def cmd_lint(args) -> int:
@@ -741,6 +799,7 @@ def cmd_daemon(args) -> int:
     from jepsen_tpu.service.drain import install_signal_drain
     from jepsen_tpu.service.server import CheckerDaemon
 
+    _perf_setup(args)
     _reset_engine_state()
     _apply_mesh_args(args)
     if args.trace:
@@ -877,6 +936,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also capture a jax.profiler XLA trace into "
                         "DIR (no-op where the profiler is "
                         "unavailable, e.g. plain CPU meshes)")
+    a.add_argument("--profile", default=None, metavar="PATH",
+                   help="load this tuned perf profile instead of the "
+                        "auto-discovered per-backend one (invalid/"
+                        "foreign/stale profiles warn and fall back to "
+                        "registry defaults)")
     a.set_defaults(fn=cmd_analyze)
 
     ts = sub.add_parser(
@@ -906,6 +970,24 @@ def build_parser() -> argparse.ArgumentParser:
                          "than this fraction below the previous row "
                          "(default 0.10)")
     pt.set_defaults(fn=cmd_perf_trend)
+
+    tu = sub.add_parser(
+        "tune",
+        help="sweep the perf-knob registry on this backend and "
+             "persist the verdict-parity-checked winners as a "
+             "per-backend profile",
+    )
+    tu.add_argument("--budget-s", type=float, default=60.0,
+                    metavar="SECONDS",
+                    help="wall-clock sweep budget; rungs past it are "
+                         "skipped and recorded as such (default 60)")
+    tu.add_argument("--knobs", default=None, metavar="NAMES",
+                    help="comma-separated knob subset to sweep "
+                         "(default: every registered knob)")
+    tu.add_argument("--dry-run", action="store_true",
+                    help="sweep and report winners without writing "
+                         "the profile")
+    tu.set_defaults(fn=cmd_tune)
 
     ln = sub.add_parser(
         "lint",

@@ -39,10 +39,14 @@ def engine_snapshot() -> dict:
     - ``streaming``: incremental-tail appends and tail launches
     - ``txn_graph``: transactional dependency-graph pipeline counters
     - ``trace``:     flight-recorder meta (enabled, event counts)
+    - ``perf``:      the self-tuning perf plane's disclosure — the
+      resolved knob ``config_hash``, whether a persisted tuned
+      profile is active, and where it was loaded from
     """
     from jepsen_tpu.checker import chaos, checkpoint, dispatch, sharded
     from jepsen_tpu.checker import streaming, txn_graph
     from jepsen_tpu.checker import wgl_bitset as bs
+    from jepsen_tpu.perf import knobs as perf_knobs
 
     return {
         "dispatch": dispatch.dispatch_stats(),
@@ -53,6 +57,7 @@ def engine_snapshot() -> dict:
         "streaming": streaming.stream_stats(),
         "txn_graph": txn_graph.txn_graph_stats(),
         "trace": _trace.trace_stats(),
+        "perf": perf_knobs.perf_snapshot(),
     }
 
 

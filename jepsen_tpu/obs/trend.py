@@ -51,6 +51,22 @@ def trend_mode(row: dict) -> str:
     return "smoke" if row.get("smoke") else "hardware"
 
 
+def drift_attribution(prev: dict, cur: dict) -> str:
+    """Classify a regression between two adjacent rows: when both
+    carry the perf plane's ``config_hash``, a hash change means the
+    resolved knob config differed between the runs ("config drift" —
+    suspect the tuned profile or a registry-default change before
+    blaming the code), identical hashes mean the knobs were identical
+    and the drop is attributable to the code under them ("code
+    drift"). Rows predating the config_hash schema can't be split."""
+    ph, ch = prev.get("config_hash"), cur.get("config_hash")
+    if not (isinstance(ph, str) and ph and isinstance(ch, str) and ch):
+        return "drift source unknown (row predates config_hash)"
+    if ph != ch:
+        return f"config drift: {ph[:8]} -> {ch[:8]}"
+    return f"code drift: config unchanged ({ch[:8]})"
+
+
 def gate_trend(
     rows: List[dict], max_regression: float
 ) -> Tuple[bool, List[str]]:
@@ -59,7 +75,8 @@ def gate_trend(
     ``max_regression`` (fractional) below its predecessor's. Returns
     (ok, messages) — ok False when ANY mode's trajectory regressed.
     Trajectories with under two comparable rows pass vacuously (the
-    message says so)."""
+    message says so). Regression messages carry a drift attribution
+    (config vs code) from the rows' config_hash stamps."""
     by_mode: dict = {}
     for r in rows:
         by_mode.setdefault(trend_mode(r), []).append(r)
@@ -87,7 +104,8 @@ def gate_trend(
             msgs.append(
                 f"{mode}: REGRESSION: vs_baseline {prev:.3f} -> "
                 f"{cur:.3f} ({drop * 100:.1f}% drop > "
-                f"{max_regression * 100:.1f}% budget)"
+                f"{max_regression * 100:.1f}% budget; "
+                f"{drift_attribution(traj[-2], traj[-1])})"
             )
         else:
             msgs.append(

@@ -1,0 +1,21 @@
+"""The self-tuning perf plane.
+
+Every launch-shape constant the engine used to hard-code — bucket
+ladders, coalescing timers, batch caps, GC cadences — was eyeballed
+once on one host (BENCH_NOTES round 9: the closure-ladder rung choice
+alone was worth 2.4x in FLOPs). This package replaces those one-host
+constants with a declarative knob registry (``perf.knobs``), a
+min-of-N verdict-parity-checked sweep (``perf.autotune``), and a
+persisted per-``(backend, n_devices, jax_version)`` profile the
+checker constructors consult — ``cli tune`` sweeps, the profile lands
+next to the XLA compile cache, and every bench trend row carries the
+resolved ``config_hash`` so perf-trend can attribute a regression to
+config drift vs code drift.
+
+The package root imports nothing heavy: ``knobs`` is pure stdlib and
+``autotune`` defers jax until a sweep or profile key is actually
+needed, so checker modules can import the registry at module scope
+without widening their import graph.
+"""
+
+from jepsen_tpu.perf import knobs  # noqa: F401  (registry re-export)

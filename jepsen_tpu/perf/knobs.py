@@ -1,0 +1,378 @@
+"""The declarative knob registry: every hand-picked perf tunable.
+
+One ``Knob`` row per tunable the engine used to hard-code: its owner
+module, the module constant it supersedes (``const`` — the planelint
+JT107 surface), the sweepable rung ladder (``domain``), the shipped
+default, which probe workload exercises it, and a safety note saying
+what the knob can and cannot change (no knob may change a verdict —
+the autotuner parity-checks every rung before trusting its timing).
+
+Owner modules stop reading their module constants inside functions and
+resolve through :func:`resolve` instead; the constants remain as the
+documented defaults (and the back-compat import surface), and a
+dedicated test pins them equal to the registry's defaults.
+
+Resolution is two dict lookups (active overrides, then the caller's
+live module-constant fallback or the registry default) — cheap enough
+for construction-time and plan-time call sites. The active override set is process-wide and installed either by
+:func:`ensure_profile` (loads the persisted per-backend profile the
+first time any checker constructs, silently staying on defaults when
+none exists or it fails validation) or explicitly by the sweep /
+tests via :func:`set_active`.
+
+This module is pure stdlib — no jax, no checker imports — so checker
+modules and the stdlib-AST analyzer can both import it at module
+scope.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+#: env switch: never load a persisted profile (tests, bisection runs)
+NO_PROFILE_ENV = "JEPSEN_TPU_NO_PROFILE"
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One tunable: identity, provenance, sweep ladder, and safety."""
+
+    name: str         # dotted registry name, e.g. "dispatch.max_batch"
+    owner: str        # repo-relative owner module
+    const: Optional[str]  # module constant it supersedes (JT107 surface)
+    kind: str         # "int" | "float" | "ladder" (tuple of ints)
+    default: Any
+    domain: Tuple     # candidate rungs the sweep may try
+    probe: str        # probe workload that exercises it: linear|txn|stream
+    safety: str       # what the knob may change (never a verdict)
+
+
+#: the registry, in sweep (coordinate-descent) order
+KNOBS: Dict[str, Knob] = {
+    k.name: k
+    for k in (
+        Knob(
+            name="dispatch.coalesce_hold_s",
+            owner="jepsen_tpu/checker/dispatch.py",
+            const=None,
+            kind="float",
+            default=0.002,
+            domain=(0.0, 0.0005, 0.001, 0.002, 0.005),
+            probe="linear",
+            safety=(
+                "age-based bucket flush timer; trades sparse-traffic "
+                "latency for coalescing width, never verdicts"
+            ),
+        ),
+        Knob(
+            name="dispatch.max_batch",
+            owner="jepsen_tpu/checker/dispatch.py",
+            const=None,
+            kind="int",
+            default=256,
+            domain=(64, 128, 256, 512),
+            probe="linear",
+            safety=(
+                "bucket occupancy at which a flush stops waiting; "
+                "bounds one launch's stack height, never verdicts"
+            ),
+        ),
+        Knob(
+            name="dispatch.max_inflight_trains",
+            owner="jepsen_tpu/checker/dispatch.py",
+            const=None,
+            kind="int",
+            default=2,
+            domain=(1, 2, 3, 4),
+            probe="linear",
+            safety=(
+                "double-buffer depth of unresolved collect trains; "
+                "deeper overlaps more host prep with device execution "
+                "at the cost of pinned device buffers"
+            ),
+        ),
+        Knob(
+            name="wgl_bitset.w_buckets",
+            owner="jepsen_tpu/checker/wgl_bitset.py",
+            const="W_BUCKETS",
+            kind="ladder",
+            default=(12, 13, 14, 15, 16, 17, 18, 19),
+            domain=(
+                (12, 13, 14, 15, 16, 17, 18, 19),
+                (12, 14, 16, 18, 19),
+                (13, 15, 17, 19),
+            ),
+            probe="linear",
+            safety=(
+                "W rung ladder for the bitset kernel (2^W-lane "
+                "tensors); every candidate tops out at 19 — Mosaic "
+                "cannot compile W=20 — so wider windows still route "
+                "to the K-frontier ladder and verdicts never change"
+            ),
+        ),
+        Knob(
+            name="wgl_bitset.rows_bucket_growth",
+            owner="jepsen_tpu/checker/wgl_bitset.py",
+            const="ROWS_BUCKET_GROWTH",
+            kind="int",
+            default=8,
+            domain=(4, 8, 16),
+            probe="linear",
+            safety=(
+                "state-row (S) padding quantum; coarser rungs stack "
+                "more shapes into one compiled kernel, finer rungs "
+                "waste fewer padded rows — padding never changes the "
+                "scanned rows' verdict"
+            ),
+        ),
+        Knob(
+            name="txn_graph.graph_buckets",
+            owner="jepsen_tpu/checker/txn_graph.py",
+            const="GRAPH_BUCKETS",
+            kind="ladder",
+            default=(4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128, 192,
+                     256, 384, 512, 768, 1024),
+            domain=(
+                (4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128, 192, 256,
+                 384, 512, 768, 1024),
+                (4, 8, 16, 32, 64, 128, 256, 512, 1024),
+                (4, 16, 64, 256, 1024),
+            ),
+            probe="txn",
+            safety=(
+                "component-size ladder for dense adjacency batches; "
+                "closure FLOPs grow with N^3 so denser rungs trade "
+                "launches for tighter stacks — components above the "
+                "last rung still take the oversize path, verdicts "
+                "are padding-invariant"
+            ),
+        ),
+        Knob(
+            name="txn_graph.packed_word_max_n",
+            owner="jepsen_tpu/checker/txn_graph.py",
+            const="PACKED_WORD_MAX_N",
+            kind="int",
+            default=32,
+            domain=(8, 16, 32),
+            probe="txn",
+            safety=(
+                "largest component N that takes the packed-uint32 "
+                "closure (word-parallel OR-gather) instead of the "
+                "batched f32 einsum; clamped to 32 (uint32 lanes), "
+                "both closures compute the same reachability"
+            ),
+        ),
+        Knob(
+            name="streaming.gc_window",
+            owner="jepsen_tpu/checker/streaming.py",
+            const=None,
+            kind="int",
+            default=0,
+            domain=(0, 64, 256),
+            probe="stream",
+            safety=(
+                "checked-prefix ops retained before seal+archive at a "
+                "clean boundary (0 = GC off); the sealed prefix's "
+                "digest keeps the verdict chain intact"
+            ),
+        ),
+        Knob(
+            name="streaming.persist_every",
+            owner="jepsen_tpu/checker/streaming.py",
+            const=None,
+            kind="int",
+            default=1,
+            domain=(1, 4, 16),
+            probe="stream",
+            safety=(
+                "verified appends per durable fsync boundary; larger "
+                "values amortize the boundary frontier fetch but "
+                "widen the crash-replay window — never verdicts"
+            ),
+        ),
+        Knob(
+            name="streaming.tail_len_bucket",
+            owner="jepsen_tpu/checker/dispatch.py",
+            const="STREAM_TAIL_BUCKET",
+            kind="int",
+            default=64,
+            domain=(16, 32, 64, 128),
+            probe="stream",
+            safety=(
+                "length-bucket quantum for coalescing stream tails "
+                "into one stacked launch; coarser buckets coalesce "
+                "more streams per launch at the cost of padded steps"
+            ),
+        ),
+    )
+}
+
+
+def knob_names() -> Tuple[str, ...]:
+    return tuple(KNOBS)
+
+
+def coerce(name: str, value: Any) -> Any:
+    """Validate + canonicalize one knob value (profile JSON carries
+    ladders as lists; ints may arrive as floats). Raises ValueError on
+    anything that cannot be the knob's kind."""
+    k = KNOBS[name]
+    if k.kind == "int":
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise ValueError(f"{name}: not an int: {value!r}")
+        iv = int(value)
+        if iv != value:
+            raise ValueError(f"{name}: not an int: {value!r}")
+        if iv < 0:
+            raise ValueError(f"{name}: negative: {value!r}")
+        return iv
+    if k.kind == "float":
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise ValueError(f"{name}: not a float: {value!r}")
+        fv = float(value)
+        if fv < 0:
+            raise ValueError(f"{name}: negative: {value!r}")
+        return fv
+    # ladder: strictly increasing non-empty tuple of positive ints
+    if not isinstance(value, (list, tuple)) or not value:
+        raise ValueError(f"{name}: not a ladder: {value!r}")
+    out = []
+    for v in value:
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise ValueError(f"{name}: non-int rung: {v!r}")
+        iv = int(v)
+        if iv != v or iv <= 0:
+            raise ValueError(f"{name}: bad rung: {v!r}")
+        out.append(iv)
+    if sorted(set(out)) != out:
+        raise ValueError(f"{name}: ladder not strictly increasing")
+    return tuple(out)
+
+
+# -- active profile state ----------------------------------------------------
+
+_state_lock = threading.Lock()
+_active: Dict[str, Any] = {}      # validated overrides (subset of KNOBS)
+_active_source: Optional[str] = None  # profile path (None = defaults)
+_profile_checked = False          # ensure_profile ran (hit or miss)
+
+
+def set_active(overrides: Optional[Dict[str, Any]],
+               source: Optional[str] = None) -> None:
+    """Install a validated override set process-wide (None/{} = back
+    to defaults). Unknown knob names and invalid values raise — the
+    profile LOADER is the silent-degrade layer, not this setter."""
+    new: Dict[str, Any] = {}
+    for name, value in (overrides or {}).items():
+        if name not in KNOBS:
+            raise ValueError(f"unknown knob: {name}")
+        new[name] = coerce(name, value)
+    global _active, _active_source
+    with _state_lock:
+        _active = new
+        _active_source = source if new or source else None
+
+
+_UNSET = object()
+
+
+def resolve(name: str, fallback: Any = _UNSET) -> Any:
+    """The one resolution path: active override else the caller's live
+    fallback else the registry default. Owner modules call this instead
+    of reading their module constants inside hot paths (planelint JT107
+    flags the raw reads); const-backed sites pass the module constant
+    as ``fallback`` so the back-compat surface — tests monkeypatching
+    ``bs.W_BUCKETS`` and the like — keeps steering the default while a
+    tuned override still wins."""
+    v = _active.get(name)
+    if v is not None:
+        return v
+    if fallback is not _UNSET:
+        return fallback
+    return KNOBS[name].default
+
+
+def active_overrides() -> Dict[str, Any]:
+    with _state_lock:
+        return dict(_active)
+
+
+def active_config() -> Dict[str, Any]:
+    """Every knob's resolved value (defaults + overrides) — the hashed
+    config surface."""
+    return {name: resolve(name) for name in KNOBS}
+
+
+def config_hash(config: Optional[Dict[str, Any]] = None) -> str:
+    """Short stable digest of the resolved knob surface: what trend
+    rows carry and perf-trend diffs to attribute config drift."""
+    cfg = config if config is not None else active_config()
+    blob = json.dumps(
+        {k: list(v) if isinstance(v, tuple) else v
+         for k, v in sorted(cfg.items())},
+        sort_keys=True, separators=(",", ":"),
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def tuned() -> bool:
+    """Whether a persisted/explicit profile is active (vs defaults)."""
+    with _state_lock:
+        return bool(_active)
+
+
+def perf_snapshot() -> dict:
+    """The perf plane's disclosure block for engine_snapshot / the
+    dryrun metric line: resolved config hash, whether a tuned profile
+    is active, and where it came from."""
+    with _state_lock:
+        return {
+            "config_hash": config_hash(),
+            "tuned": bool(_active),
+            "profile": _active_source,
+            "overrides": dict(_active),
+        }
+
+
+def ensure_profile() -> None:
+    """Load the persisted per-backend profile once per process, if one
+    exists. Called by every checker constructor — so it must be cheap
+    on the common (no-profile) path and NEVER raise: a corrupt,
+    foreign-keyed, or stale profile silently degrades to defaults.
+
+    The no-profile fast path deliberately avoids jax: the profile key
+    needs the backend name, but when the profile directory is absent
+    or empty there is nothing to key against, and construction-only
+    callers (tests, tooling) should not trigger backend init."""
+    global _profile_checked
+    if _profile_checked:
+        return
+    with _state_lock:
+        if _profile_checked:
+            return
+        _profile_checked = True
+        already_active = bool(_active)
+    if already_active or os.environ.get(NO_PROFILE_ENV):
+        return
+    try:
+        from jepsen_tpu.perf import autotune
+
+        if not autotune.any_profile_present():
+            return
+        autotune.load_active_profile()
+    except Exception:
+        return  # the perf plane never breaks a checker construction
+
+
+def _reset_for_tests() -> None:
+    """Drop the active profile AND the once-per-process load latch."""
+    global _active, _active_source, _profile_checked
+    with _state_lock:
+        _active = {}
+        _active_source = None
+        _profile_checked = False

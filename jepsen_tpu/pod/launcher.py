@@ -86,17 +86,15 @@ def pod_env(
     env["PYTHONPATH"] = (
         _repo_root() + os.pathsep + env.get("PYTHONPATH", "")
     ).rstrip(os.pathsep)
-    # Persistent compile cache shared across pod spawns (same per-user
-    # path as bench.py): tier-1 launches several short-lived pods, and
-    # without this every member re-pays the full XLA compile of the
-    # same shard_map programs.
-    env.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(
-            os.path.expanduser("~"), ".cache", "jepsen_tpu",
-            "jax_cache",
-        ),
-    )
+    # Persistent compile cache shared across pod spawns AND the
+    # single-process entry points (cli analyze/daemon, bench — they
+    # call perf.autotune.enable_persistent_compile_cache, the same
+    # path): tier-1 launches several short-lived pods, and without
+    # this every member re-pays the full XLA compile of the same
+    # shard_map programs. The perf-profile store lives beside it.
+    from jepsen_tpu.perf.autotune import compile_cache_dir
+
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", compile_cache_dir())
     return env
 
 

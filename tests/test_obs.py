@@ -257,12 +257,15 @@ def test_engine_snapshot_is_the_one_reader():
     snap = engine_snapshot()
     assert set(snap) == {
         "dispatch", "launch", "mesh", "resilience", "checkpoint",
-        "streaming", "txn_graph", "trace",
+        "streaming", "txn_graph", "trace", "perf",
     }
     # sections carry their planes' own snapshot shapes
     assert "launches" in snap["launch"]
     assert "enabled" in snap["trace"]
     assert isinstance(snap["txn_graph"], dict)
+    # the perf plane discloses the knob config every number ran under
+    assert "config_hash" in snap["perf"]
+    assert "tuned" in snap["perf"]
 
 
 def test_reset_engine_stats_resets_every_plane():

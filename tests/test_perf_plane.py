@@ -1,0 +1,470 @@
+"""The self-tuning perf plane (PR 17): knob registry, persisted
+per-backend profiles, the verdict-parity-checked sweep, and the
+surfaces that disclose the active config (engine_snapshot, trend rows,
+cli tune).
+
+The invariants under test:
+
+- the registry's defaults ARE the module constants they supersede (a
+  drifted default would silently change behavior for everyone);
+- a persisted profile round-trips byte-stably, and EVERY defect —
+  corrupt JSON, foreign backend key, stale jax version, doctored
+  knob values — silently degrades to registry defaults;
+- the sweep picks the planted-fastest rung under a fake clock, and a
+  rung that flips a probe verdict can never win regardless of speed
+  (differential-tested here under deliberately extreme knobs);
+- constructors demonstrably consult the loaded profile;
+- cli tune honors its exit-code contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+
+import pytest
+
+from jepsen_tpu.perf import autotune, knobs
+
+
+#: a fixed profile key used wherever the test must not depend on the
+#: ambient jax install (current_key() is exercised separately)
+FAKE_KEY = {"backend": "cpu", "n_devices": 8, "jax_version": "9.9.9"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_perf_state(monkeypatch, tmp_path):
+    """Every test starts on registry defaults with an empty, private
+    profile store, and leaves no active profile behind."""
+    monkeypatch.delenv(autotune.PROFILE_ENV, raising=False)
+    monkeypatch.delenv(autotune.FAKE_CLOCK_ENV, raising=False)
+    monkeypatch.delenv(knobs.NO_PROFILE_ENV, raising=False)
+    monkeypatch.setenv(
+        autotune.PROFILE_DIR_ENV, str(tmp_path / "profiles")
+    )
+    monkeypatch.setenv(
+        "JAX_COMPILATION_CACHE_DIR", str(tmp_path / "jax_cache")
+    )
+    knobs._reset_for_tests()
+    yield
+    knobs._reset_for_tests()
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_registry_defaults_match_module_constants():
+    """Knobs that supersede a module constant must default to exactly
+    that constant's value — the registry is a relabeling of the
+    hand-picked values, never a silent change to them."""
+    from jepsen_tpu.checker import dispatch, txn_graph, wgl_bitset
+
+    published = {
+        "wgl_bitset.w_buckets": wgl_bitset.W_BUCKETS,
+        "wgl_bitset.rows_bucket_growth": wgl_bitset.ROWS_BUCKET_GROWTH,
+        "txn_graph.graph_buckets": txn_graph.GRAPH_BUCKETS,
+        "txn_graph.packed_word_max_n": txn_graph.PACKED_WORD_MAX_N,
+        "streaming.tail_len_bucket": dispatch.STREAM_TAIL_BUCKET,
+    }
+    for name, want in published.items():
+        assert knobs.KNOBS[name].default == want, name
+    # every const-carrying knob is covered above (a new one must add
+    # its module constant to this test)
+    assert {n for n, k in knobs.KNOBS.items() if k.const} == set(
+        published
+    )
+    # and every default is one of its own sweep rungs, so the sweep's
+    # parity baseline is always reachable
+    for name, k in knobs.KNOBS.items():
+        assert k.default in k.domain, name
+
+
+def test_config_hash_tracks_overrides():
+    base = knobs.config_hash()
+    knobs.set_active({"dispatch.max_batch": 64}, source="test")
+    assert knobs.config_hash() != base
+    assert knobs.tuned()
+    snap = knobs.perf_snapshot()
+    assert snap["profile"] == "test"
+    assert snap["overrides"] == {"dispatch.max_batch": 64}
+    knobs.set_active({}, source=None)
+    assert knobs.config_hash() == base and not knobs.tuned()
+
+
+def test_set_active_rejects_garbage_loudly():
+    with pytest.raises(ValueError):
+        knobs.set_active({"nope.such_knob": 1}, source="test")
+    with pytest.raises(ValueError):
+        knobs.set_active({"dispatch.max_batch": -4}, source="test")
+    with pytest.raises(ValueError):
+        knobs.set_active(
+            {"wgl_bitset.w_buckets": (19, 12)}, source="test"
+        )
+    # failed installs leave defaults active
+    assert not knobs.tuned()
+
+
+# -- profile store -----------------------------------------------------------
+
+
+def test_profile_round_trip_and_byte_stability(tmp_path):
+    overrides = {
+        "dispatch.max_batch": 128,
+        "wgl_bitset.w_buckets": [12, 14, 16, 19],
+    }
+    path = autotune.write_profile(
+        overrides, key=FAKE_KEY, evidence={"rows": []}
+    )
+    got = autotune.load_profile(path, key=FAKE_KEY)
+    assert got is not None
+    loaded, doc = got
+    assert loaded["dispatch.max_batch"] == 128
+    assert loaded["wgl_bitset.w_buckets"] == (12, 14, 16, 19)
+    assert doc["key"] == FAKE_KEY
+    # evidence lands beside the profile, never inside it
+    assert os.path.exists(path[: -len(".json")] + ".evidence.json")
+    # byte-stable: a second write of the same winners is identical
+    first = open(path, "rb").read()
+    autotune.write_profile(overrides, key=FAKE_KEY)
+    assert open(path, "rb").read() == first
+
+
+def test_profile_defects_degrade_to_defaults(tmp_path):
+    path = autotune.write_profile(
+        {"dispatch.max_batch": 128}, key=FAKE_KEY
+    )
+    # corrupt JSON
+    bad = str(tmp_path / "corrupt.json")
+    with open(bad, "w") as f:
+        f.write(open(path).read()[:40])
+    assert autotune.load_profile(bad, key=FAKE_KEY) is None
+    # foreign key: right file, different backend/device count
+    assert autotune.load_profile(
+        path, key=dict(FAKE_KEY, backend="tpu")
+    ) is None
+    assert autotune.load_profile(
+        path, key=dict(FAKE_KEY, n_devices=4)
+    ) is None
+    # stale jax version
+    assert autotune.load_profile(
+        path, key=dict(FAKE_KEY, jax_version="0.0.1")
+    ) is None
+    # doctored knob value: hash no longer matches the claimed knobs
+    doc = json.load(open(path))
+    doc["knobs"]["dispatch.max_batch"] = 512
+    doctored = str(tmp_path / "doctored.json")
+    with open(doctored, "w") as f:
+        json.dump(doc, f)
+    assert autotune.load_profile(doctored, key=FAKE_KEY) is None
+    # missing file
+    assert autotune.load_profile(
+        str(tmp_path / "absent.json"), key=FAKE_KEY
+    ) is None
+    # and write_profile refuses unknown knobs loudly (tune-time error,
+    # not a load-time silent drop)
+    with pytest.raises(ValueError):
+        autotune.write_profile({"nope": 1}, key=FAKE_KEY)
+
+
+def test_ensure_profile_loads_for_current_key():
+    """The construction seam end-to-end: a profile persisted for THIS
+    process's (backend, n_devices, jax_version) is found and installed
+    by ensure_profile; a corrupt one in the same slot is not."""
+    key = autotune.current_key()
+    path = autotune.write_profile(
+        {"dispatch.max_batch": 128}, key=key
+    )
+    knobs._reset_for_tests()
+    knobs.ensure_profile()
+    assert knobs.resolve("dispatch.max_batch") == 128
+    assert knobs.perf_snapshot()["profile"] == path
+    # corrupt the stored profile: next process (fresh latch) must
+    # silently come up on defaults
+    with open(path, "w") as f:
+        f.write("{not json")
+    knobs._reset_for_tests()
+    knobs.ensure_profile()
+    assert knobs.resolve("dispatch.max_batch") == 256
+    assert not knobs.tuned()
+
+
+def test_constructors_consult_the_profile():
+    """dispatch / txn_graph / streaming demonstrably load the
+    persisted profile at construction."""
+    coarse = knobs.KNOBS["txn_graph.graph_buckets"].domain[-1]
+    autotune.write_profile(
+        {
+            "dispatch.max_batch": 128,
+            "dispatch.max_inflight_trains": 3,
+            "streaming.tail_len_bucket": 32,
+            "streaming.persist_every": 4,
+            "streaming.gc_window": 64,
+            "txn_graph.graph_buckets": coarse,
+        },
+        key=autotune.current_key(),
+    )
+    knobs._reset_for_tests()
+
+    from jepsen_tpu.checker.dispatch import DispatchPlane
+    from jepsen_tpu.checker.streaming import StreamingCheck
+    from jepsen_tpu.checker.txn_graph import TxnGraphChecker
+
+    plane = DispatchPlane(interpret=True)
+    try:
+        assert plane.max_batch == 128
+        assert plane.max_inflight_trains == 3
+        assert plane._tail_bucket == 32
+    finally:
+        plane.close()
+    assert TxnGraphChecker().buckets == tuple(coarse)
+    sc = StreamingCheck(model="cas-register", interpret=True)
+    assert sc.persist_every == 4
+    assert sc.gc_window == 64
+    # explicit arguments still beat the profile
+    plane = DispatchPlane(interpret=True, max_batch=64)
+    try:
+        assert plane.max_batch == 64
+    finally:
+        plane.close()
+
+
+def test_no_profile_env_disables_loading(monkeypatch):
+    autotune.write_profile(
+        {"dispatch.max_batch": 128}, key=autotune.current_key()
+    )
+    monkeypatch.setenv(knobs.NO_PROFILE_ENV, "1")
+    knobs._reset_for_tests()
+    knobs.ensure_profile()
+    assert knobs.resolve("dispatch.max_batch") == 256
+
+
+# -- sweep -------------------------------------------------------------------
+
+
+def _planted_measure(table):
+    """A measure seam with planted costs (parity verdicts still come
+    from the real probe runs)."""
+
+    def measure(run, name, idx):
+        return float(table[name][idx]), run()
+
+    return measure
+
+
+def test_sweep_picks_planted_fastest_rung():
+    """Deterministic fake-clock sweep: the winner is exactly the rung
+    the cost table plants as fastest, and the evidence records every
+    rung with its parity bit."""
+    res = autotune.run_sweep(
+        budget_s=600.0,
+        only=["streaming.persist_every"],
+        measure=_planted_measure(
+            {"streaming.persist_every": [3.0, 2.0, 1.0]}
+        ),
+    )
+    # domain is (1, 4, 16): index 2 planted fastest
+    assert res["overrides"] == {"streaming.persist_every": 16}
+    rows = res["evidence"]["streaming.persist_every"]
+    assert [r["rung"] for r in rows] == [1, 4, 16]
+    assert all(r["parity"] for r in rows)
+    assert res["skipped"] == []
+    # sweeping restored the pre-sweep state (defaults here)
+    assert not knobs.tuned()
+
+
+def test_sweep_fake_clock_env(monkeypatch):
+    """The JEPSEN_TPU_TUNE_FAKE_CLOCK seam tune-smoke.sh uses: costs
+    come from the env table, winners follow it."""
+    monkeypatch.setenv(
+        autotune.FAKE_CLOCK_ENV,
+        json.dumps(
+            {"streaming.persist_every": {"0": 0.5, "1": 2.0, "2": 2.0}}
+        ),
+    )
+    res = autotune.run_sweep(
+        budget_s=600.0, only=["streaming.persist_every"]
+    )
+    # index 0 is the default (1): planted fastest, so no off-default
+    # winner — but the knob was swept and recorded
+    assert res["overrides"]["streaming.persist_every"] == 1
+    assert len(res["evidence"]["streaming.persist_every"]) == 3
+
+
+def test_sweep_rejects_verdict_flipping_rungs():
+    """Parity is admission, speed is only ordering: a rung whose
+    probe verdict differs from the baseline can never win, even at
+    planted cost 0."""
+
+    def measure(run, name, idx):
+        verdict = run()
+        if idx == 0:  # cheapest rung "flips" the verdict
+            return 0.0, {"valid?": "flipped"}
+        return 1.0 + idx, verdict
+
+    res = autotune.run_sweep(
+        budget_s=600.0, only=["streaming.persist_every"],
+        measure=measure,
+    )
+    rows = res["evidence"]["streaming.persist_every"]
+    assert rows[0]["parity"] is False
+    # index 1 (value 4) is the cheapest parity-holding rung
+    assert res["overrides"]["streaming.persist_every"] == 4
+
+
+def test_sweep_unknown_knob_raises():
+    with pytest.raises(ValueError):
+        autotune.run_sweep(only=["nope.such_knob"])
+
+
+def test_verdict_parity_under_extreme_knobs():
+    """The differential the profile's safety story rests on: every
+    probe verdict is identical under registry defaults and under
+    deliberately extreme knobs — a tiny dispatch batch, the coarsest
+    GRAPH_BUCKETS ladder, a gc window of 1, eager persistence."""
+    extreme = {
+        "dispatch.max_batch": 64,
+        "txn_graph.graph_buckets":
+            knobs.KNOBS["txn_graph.graph_buckets"].domain[-1],
+        "streaming.gc_window": 1,
+        "streaming.persist_every": 1,
+        "streaming.tail_len_bucket": 16,
+    }
+    for probe in ("linear", "txn", "stream"):
+        run = autotune._PROBES[probe]()
+        knobs.set_active({}, source=None)
+        base = run()
+        knobs.set_active(extreme, source="test-extreme")
+        try:
+            got = run()
+        finally:
+            knobs.set_active({}, source=None)
+        assert got == base, f"{probe}: {got} != {base}"
+        assert base.get("valid?") is not None, probe
+
+
+# -- cli ---------------------------------------------------------------------
+
+
+def test_cli_tune_exit_codes(monkeypatch, capsys):
+    from jepsen_tpu.cli import EXIT_USAGE, main
+
+    # dry run: plan printed, nothing written, exit 0
+    assert main(["tune", "--dry-run"]) == 0
+    out = capsys.readouterr().out
+    assert "tune plan" in out and "dispatch.max_batch" in out
+    assert not os.listdir(autotune.profile_dir()) if os.path.isdir(
+        autotune.profile_dir()
+    ) else True
+    # unknown knob: usage, not crash
+    assert main(["tune", "--knobs", "nope.such_knob"]) == EXIT_USAGE
+    # real (fake-clocked) sweep: profile written, exit 0
+    monkeypatch.setenv(
+        autotune.FAKE_CLOCK_ENV,
+        json.dumps({"streaming.persist_every": {"2": 0.1}}),
+    )
+    assert main(
+        ["tune", "--budget-s", "600",
+         "--knobs", "streaming.persist_every"]
+    ) == 0
+    out = capsys.readouterr().out
+    path = autotune.profile_path(autotune.current_key())
+    assert os.path.exists(path)
+    assert path in out
+    got = autotune.load_profile(path)
+    assert got is not None
+    assert got[0]["streaming.persist_every"] == 16
+    # and a fresh process-equivalent (reset latch) picks it up
+    knobs._reset_for_tests()
+    knobs.ensure_profile()
+    assert knobs.resolve("streaming.persist_every") == 16
+
+
+def test_cli_analyze_profile_flag_warns_on_bad_profile(
+    tmp_path, capsys
+):
+    """--profile with an unreadable file warns and falls back to
+    defaults instead of failing the analysis."""
+    import argparse
+
+    from jepsen_tpu.cli import _perf_setup
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("{nope")
+    args = argparse.Namespace(profile=str(bad))
+    _perf_setup(args)
+    err = capsys.readouterr().err
+    assert "invalid, foreign, or stale" in err
+    assert not knobs.tuned()
+
+
+# -- disclosure surfaces -----------------------------------------------------
+
+
+def test_engine_snapshot_discloses_perf_plane():
+    from jepsen_tpu.obs.snapshot import engine_snapshot
+
+    knobs.set_active({"dispatch.max_batch": 64}, source="/tmp/p.json")
+    snap = engine_snapshot()
+    assert snap["perf"]["tuned"] is True
+    assert snap["perf"]["profile"] == "/tmp/p.json"
+    assert len(snap["perf"]["config_hash"]) == 12
+
+
+def test_trend_rows_carry_config_identity(tmp_path):
+    import sys
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import bench
+
+    knobs.set_active({"dispatch.max_batch": 64}, source="p.json")
+    row = bench.trend_row_from_record(
+        {"value": 1.0, "vs_baseline": 2.5, "residency": {}},
+        ts="2026-08-07T00:00:00+00:00", smoke=True,
+    )
+    assert row["config_hash"] == knobs.config_hash()
+    assert row["tuned"] is True
+    assert row["knobs"]["dispatch.max_batch"] == 64
+    # ladders serialize as lists (the row must be plain JSON)
+    assert isinstance(row["knobs"]["wgl_bitset.w_buckets"], list)
+    json.dumps(row)
+
+
+def test_gate_trend_attributes_drift():
+    from jepsen_tpu.obs.trend import drift_attribution, gate_trend
+
+    base = {"mode": "hardware", "smoke": False}
+    mk = lambda v, h: dict(base, vs_baseline=v, config_hash=h)  # noqa: E731
+    # same hash: code drift
+    ok, msgs = gate_trend([mk(11.0, "aaaa11112222"),
+                           mk(5.0, "aaaa11112222")], 0.1)
+    assert not ok
+    assert any("code drift" in m for m in msgs)
+    # different hash: config drift
+    ok, msgs = gate_trend([mk(11.0, "aaaa11112222"),
+                           mk(5.0, "bbbb33334444")], 0.1)
+    assert not ok
+    assert any("config drift: aaaa1111 -> bbbb3333" in m for m in msgs)
+    # pre-schema rows can't be split
+    ok, msgs = gate_trend(
+        [dict(base, vs_baseline=11.0), dict(base, vs_baseline=5.0)],
+        0.1,
+    )
+    assert not ok
+    assert any("predates config_hash" in m for m in msgs)
+    assert "unknown" in drift_attribution({}, {})
+
+
+def test_jit_cache_key_carries_packed_max():
+    """The staleness hazard JT106 exists for, closed for the knob
+    plane: retuning packed_word_max_n mid-process must produce a
+    DIFFERENT kernel, never reuse one traced under the other
+    crossover branch."""
+    from jepsen_tpu.checker import txn_graph as tg
+
+    k_default = tg._graph_kernel(4, True, False, 32)
+    k_retuned = tg._graph_kernel(4, True, False, 8)
+    assert k_default is not k_retuned
+    assert k_default is tg._graph_kernel(4, True, False, 32)

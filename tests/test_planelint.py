@@ -173,6 +173,33 @@ def f(x, opts=None):
     return x
 """,
     ),
+    "JT107": (
+        """
+GRAPH_BUCKETS = (4, 8, 16)
+
+def plan(n):
+    for b in GRAPH_BUCKETS:
+        if n <= b:
+            return b
+    return GRAPH_BUCKETS[-1]
+""",
+        """
+GRAPH_BUCKETS = (4, 8, 16)
+
+def _graph_buckets():
+    from jepsen_tpu.perf import knobs as _perf_knobs
+    try:
+        return _perf_knobs.resolve("txn_graph.graph_buckets")
+    except Exception:
+        return GRAPH_BUCKETS
+
+def plan(n, buckets=GRAPH_BUCKETS):
+    for b in _graph_buckets():
+        if n <= b:
+            return b
+    return buckets[-1]
+""",
+    ),
     "JT201": (
         """
 CORPUS_STATS = {"hits": 0}
@@ -588,7 +615,7 @@ def test_rule_catalog_partitions_by_family():
     all_rules = list(analysis.META_RULES) + family_rules
     assert len(all_rules) == len(set(all_rules))
     assert set(all_rules) == set(analysis.RULES)
-    assert analysis.rules_total() == len(analysis.RULES) == 24
+    assert analysis.rules_total() == len(analysis.RULES) == 25
 
 
 def test_host_get_funnel_itself_is_exempt():
@@ -948,7 +975,7 @@ def test_cli_json_contract():
     assert rec["clean"] is True
     assert rec["findings"] == []
     # per-rule descriptions and the catalog size ride the report
-    assert rec["rules_total"] == analysis.rules_total() == 24
+    assert rec["rules_total"] == analysis.rules_total() == 25
     assert set(rec["rules"]) == set(analysis.RULES)
     for meta in rec["rules"].values():
         assert meta["title"] and meta["invariant"]

@@ -81,7 +81,7 @@ def _strip(out):
     return {
         k: v for k, v in out.items()
         if k not in ("method", "wall_s", "tenant", "check_id",
-                     "checkpoint", "degraded")
+                     "checkpoint", "degraded", "race_winner")
     }
 
 

@@ -116,11 +116,15 @@ def test_graft_entry_contract(capfd):
     from jepsen_tpu import analysis
 
     assert rec["lint_rules_total"] == analysis.rules_total()
-    assert rec["lint_rules_total"] >= 22
+    assert rec["lint_rules_total"] >= 25
     # Flight-recorder liveness rides the same line: the dryrun runs
     # traced, so the metric that claims the floor was paid once comes
     # with the timeline that shows where.
     assert int(rec["trace_spans"]) > 0
+    # Perf-plane identity rides the same line: the knob config this
+    # number was measured under is always disclosed — a profile path
+    # when a tuned profile loaded, the defaults config hash otherwise.
+    assert isinstance(rec["tuned_profile"], str) and rec["tuned_profile"]
 
 
 def test_sharded_at_scale_with_escalation_keys():
