@@ -135,6 +135,13 @@ def trend_row_from_record(record: dict, *, ts=None, smoke=None) -> dict:
         # the sampled-recorder config + its measured overhead (the
         # production tracing story: per-kind mask, 1-in-N sampling)
         "trace_sampled": record.get("trace_sampled"),
+        # fleet rows stamp their member count; solo rows omit the key
+        # (trend_fleet defaults to 1), so a 2-member aggregate is
+        # never gated against a solo trajectory.
+        **(
+            {"fleet_size": int(record["fleet_size"])}
+            if record.get("fleet_size") else {}
+        ),
         # smoke rows are flow validations, not measurements; the flag
         # rides along for old readers, and "mode" names the row's
         # trajectory explicitly — perf-trend gates each mode against
@@ -1184,6 +1191,289 @@ def bench_streams_1k() -> None:
     }))
 
 
+# -- fleet scale-out (--fleet N) ---------------------------------------------
+
+
+def bench_fleet(n_members: int) -> None:
+    """N-member fleet behind the front door vs one solo daemon
+    (--fleet N): near-linear tenant-throughput scale-out, hard-gated.
+
+    Both sides run the SAME multi-tenant workload (distinct histories
+    per tenant and per check, so the verdict memo never shortcuts a
+    timed check): the solo side is one checker-daemon subprocess
+    driven directly, the fleet side is n_members subprocesses behind
+    a proxy-mode FleetFrontDoor (consistent-hash routing + steals).
+    Every member is warmed with one untimed check before measurement
+    so first-compile never lands inside a timed window.
+
+    Gates (the PR 18 acceptance):
+    - scaleout = solo_wall / fleet_wall must clear {2: 1.7x, 3: 2.3x,
+      4: 3.0x} (0.75*n beyond) — HARD (SystemExit 7) when the host
+      has at least n_members+1 CPU cores; on an under-provisioned
+      host the processes time-slice one core and the ratio measures
+      the scheduler, so the run is labeled host_provisioned=false and
+      the throughput gate is reported, not enforced.
+    - per-member launch discipline: syncs_per_check (host_syncs delta
+      / completed delta over the timed window, from each member's
+      /stats) stays <= 1.0 + 0.05 on EVERY member — always HARD
+      (SystemExit 7): fleeting the daemon must not regress the
+      one-sync dispatch train.
+
+    Emits one JSON line (metric fleet_scaleout, fleet_size stamped)
+    and appends a trend row — trend_key segregates the fleet
+    trajectory ("smoke/fleetN") from solo rows.
+    """
+    import os
+    import tempfile
+    import threading
+    import traceback
+
+    import jax
+
+    from jepsen_tpu.pod import launcher
+    from jepsen_tpu.service.client import CheckerClient
+    from jepsen_tpu.service.frontdoor import FleetFrontDoor
+    from jepsen_tpu.service.membership import FleetRegistry
+    from jepsen_tpu.sim import gen_register_history
+
+    assert n_members >= 2, "--fleet N needs N >= 2 (solo is the baseline)"
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:
+        os.environ["JEPSEN_TPU_INTERPRET"] = "1"
+
+    n_tenants = _n(4 * n_members, 2 * n_members)
+    checks_per_tenant = _n(6, 4)
+    n_ops = _n(400, 200)
+    member_devices = _n(4, 2)
+    syncs_eps = 0.05
+
+    # Clean same-shape histories (p_crash=0, fixed n_ops — the
+    # one-bucket convention from test_dispatch): every check rides the
+    # SAME compiled kernel shape, so the one warmup check per member
+    # covers compilation and the timed windows measure steady-state
+    # check throughput on both sides. Distinct seed per (tenant,
+    # check): distinct content, so no verdict-memo hit ever times as
+    # work.
+    hists = {
+        t: [
+            gen_register_history(
+                random.Random(7000 + 97 * t + i), n_ops=n_ops,
+                n_procs=5, p_crash=0.0,
+            )
+            for i in range(checks_per_tenant)
+        ]
+        for t in range(n_tenants)
+    }
+    warm_hist = gen_register_history(
+        random.Random(6999), n_ops=n_ops, n_procs=5, p_crash=0.0
+    )
+
+    def run_load(port: int) -> float:
+        """All tenants concurrently, one client thread each; the wall
+        covers submit-to-verdict for the whole workload."""
+        errs = []
+
+        def worker(t):
+            try:
+                c = CheckerClient(
+                    port=port, tenant=f"bench-t{t}", timeout_s=600,
+                    retries=8, backoff_s=0.25,
+                )
+                for h in hists[t]:
+                    out = c.check(h, model="cas-register")
+                    assert "valid?" in out, out
+            except Exception:
+                errs.append(traceback.format_exc())
+
+        threads = [
+            threading.Thread(target=worker, args=(t,), daemon=True)
+            for t in range(n_tenants)
+        ]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        assert not errs, "fleet load errors:\n" + "\n".join(errs)
+        return wall
+
+    def _member_port(url: str) -> int:
+        return int(url.rsplit(":", 1)[1])
+
+    def _stop(procs, budget_s=30.0):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + budget_s
+        for p in procs:
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except Exception:
+                p.kill()
+                p.wait(timeout=10)
+
+    root = tempfile.mkdtemp(prefix="bench-fleet-")
+
+    # -- solo baseline: one member subprocess, driven directly --------
+    solo_fdir = os.path.join(root, "solo-fleet")
+    solo_proc = launcher.spawn_fleet_member(
+        0, solo_fdir, os.path.join(root, "solo-store"),
+        n_local_devices=member_devices, interpret=on_cpu,
+        log_path=os.path.join(root, "solo.log"),
+    )
+    try:
+        launcher.wait_fleet(solo_fdir, 1, timeout_s=240.0)
+        solo_port = _member_port(
+            FleetRegistry(solo_fdir).alive_members()[0].url
+        )
+        warm = CheckerClient(
+            port=solo_port, tenant="warm", timeout_s=600
+        )
+        assert "valid?" in warm.check(warm_hist, model="cas-register")
+        s0 = warm.stats()
+        solo_wall = run_load(solo_port)
+        s1 = warm.stats()
+    finally:
+        _stop([solo_proc])
+
+    def _svc_counts(stats: dict) -> tuple:
+        tenants = stats.get("tenants") or {}
+        done = sum(
+            int(r.get("completed", 0)) for r in tenants.values()
+        )
+        syncs = int((stats.get("launch") or {}).get("host_syncs", 0))
+        return done, syncs
+
+    solo_done = _svc_counts(s1)[0] - _svc_counts(s0)[0]
+    solo_syncs = _svc_counts(s1)[1] - _svc_counts(s0)[1]
+
+    # -- fleet: n_members subprocesses behind the proxy front door ----
+    fdir = os.path.join(root, "fleet")
+    members = [
+        launcher.spawn_fleet_member(
+            i, fdir, os.path.join(root, "fleet-store"),
+            n_local_devices=member_devices, interpret=on_cpu,
+            log_path=os.path.join(root, f"member-{i:03d}.log"),
+        )
+        for i in range(n_members)
+    ]
+    door = None
+    try:
+        launcher.wait_fleet(
+            fdir, n_members, timeout_s=240.0 + 60.0 * n_members
+        )
+        door = FleetFrontDoor(fdir, port=0, mode="proxy")
+        door_thread = threading.Thread(
+            target=door.serve_forever, daemon=True
+        )
+        door_thread.start()
+        # Warm every member directly (routing would leave non-owners
+        # cold, and a steal can land work on any member mid-window).
+        for m in FleetRegistry(fdir).alive_members():
+            c = CheckerClient(
+                port=_member_port(m.url), tenant="warm", timeout_s=600
+            )
+            assert "valid?" in c.check(warm_hist, model="cas-register")
+        before = door.fleet_stats()["members"]
+        fleet_wall = run_load(door.port)
+        fs = door.fleet_stats()
+        after = fs["members"]
+    finally:
+        _stop(members, budget_s=60.0)
+        if door is not None:
+            door.shutdown()
+
+    # -- per-member launch discipline (always hard) -------------------
+    per_member = []
+    worst_spc = 0.0
+    for mid in sorted(after):
+        b = before.get(mid) or {}
+        done = after[mid]["completed"] - int(b.get("completed", 0))
+        syncs = (
+            after[mid]["host_syncs"] - int(b.get("host_syncs", 0))
+        )
+        spc = (syncs / done) if done else 0.0
+        worst_spc = max(worst_spc, spc)
+        per_member.append({
+            "member": mid,
+            "completed": done,
+            "host_syncs": syncs,
+            "syncs_per_check": round(spc, 4),
+        })
+    total_done = sum(r["completed"] for r in per_member)
+
+    scaleout = solo_wall / fleet_wall if fleet_wall else None
+    floors = {2: 1.7, 3: 2.3, 4: 3.0}
+    floor = floors.get(n_members, 0.75 * n_members)
+    host_provisioned = (os.cpu_count() or 1) >= n_members + 1
+
+    record = {
+        "metric": "fleet_scaleout",
+        "value": round(scaleout, 3) if scaleout else None,
+        "unit": f"x (solo wall / fleet-{n_members} wall)",
+        "backend": jax.default_backend(),
+        "fleet_size": n_members,
+        "n_tenants": n_tenants,
+        "checks_per_tenant": checks_per_tenant,
+        "n_ops": n_ops,
+        "solo_wall_s": round(solo_wall, 3),
+        "fleet_wall_s": round(fleet_wall, 3),
+        "solo_syncs_per_check": round(
+            solo_syncs / solo_done, 4
+        ) if solo_done else None,
+        "per_member": per_member,
+        "door": fs["door"],
+        "floor": floor,
+        "host_provisioned": host_provisioned,
+        # the trend columns: the fleet trajectory gates on the
+        # scale-out ratio, and on the WORST member's launch discipline
+        "vs_baseline": round(scaleout, 3) if scaleout else None,
+        "residency": {"syncs_per_check": round(worst_spc, 4)},
+        "smoke": SMOKE,
+    }
+    print(json.dumps(record))
+
+    expect = n_tenants * checks_per_tenant
+    if total_done < expect:
+        print(
+            f"FLEET GATE: members completed {total_done} checks, "
+            f"workload was {expect} — checks were lost or bypassed "
+            "the fleet",
+            file=sys.stderr,
+        )
+        raise SystemExit(7)
+    if worst_spc > 1.0 + syncs_eps:
+        print(
+            f"FLEET GATE: a member's syncs_per_check hit "
+            f"{worst_spc:.3f} (> 1.0 + {syncs_eps}) — fleeting the "
+            "daemon regressed the one-sync dispatch train "
+            f"({json.dumps(per_member)})",
+            file=sys.stderr,
+        )
+        raise SystemExit(7)
+    if scaleout is not None and scaleout < floor:
+        msg = (
+            f"fleet-{n_members} scaleout {scaleout:.2f}x below the "
+            f"{floor:.2f}x floor (solo {solo_wall:.2f}s vs fleet "
+            f"{fleet_wall:.2f}s)"
+        )
+        if host_provisioned:
+            print(f"FLEET GATE: {msg}", file=sys.stderr)
+            raise SystemExit(7)
+        print(
+            f"fleet bench: {msg} — host has {os.cpu_count() or 1} "
+            f"core(s) for {n_members}+1 processes; time-slicing "
+            "measures the scheduler, not the fleet. Gate reported, "
+            "not enforced (host_provisioned=false).",
+            file=sys.stderr,
+        )
+
+    if "--no-trend" not in sys.argv:
+        path = append_trend_row(trend_row_from_record(record))
+        print(f"trend ledger: appended to {path}", file=sys.stderr)
+
+
 # -- reduction configs (3, 4, 5) ---------------------------------------------
 
 
@@ -1849,10 +2139,10 @@ def main() -> None:
         # all five families (incl. D lockorder / E determinism) must
         # be active before the number is publishable.
         _rules_total = analysis.rules_total()
-        if _rules_total < 25:
+        if _rules_total < 26:
             raise SystemExit(
                 f"bench: planelint catalog shrank to {_rules_total} "
-                "rules (< 25): a family is disabled; refusing to "
+                "rules (< 26): a family is disabled; refusing to "
                 "publish"
             )
         print(
@@ -1941,6 +2231,15 @@ def main() -> None:
 
     if "--streams-1k" in sys.argv:
         bench_streams_1k()
+        return
+
+    _fleet = _argval("--fleet")
+    if _fleet is not None:
+        try:
+            _fleet_n = int(_fleet)
+        except ValueError:
+            raise SystemExit("usage: --fleet N (an integer >= 2)")
+        bench_fleet(_fleet_n)
         return
 
     if "--profile" in sys.argv:

@@ -22,6 +22,15 @@ Rules:
   of a stats structure outside a lock — a torn snapshot. Single
   scalar subscript reads stay allowed (atomic under the GIL); the
   sanctioned path is a locked ``snapshot()`` helper.
+- JT206 cross-member membership/routing state (``self._members``,
+  ``self._ring``, ``routing``/``route_table`` attributes) mutated
+  outside the membership lock. The fleet's routing tier caches a
+  consistent-hash ring derived from the live member set; an unlocked
+  rebind or in-place edit lets a concurrent router read a
+  half-updated ring and route a tenant to two owners at once —
+  admission ledgers and stream state then split across members.
+  ``__init__`` bodies are exempt (single-threaded construction), and
+  locals are out of scope: only attribute state can be shared.
 """
 
 from __future__ import annotations
@@ -64,6 +73,31 @@ _HOOK_RE = re.compile(
 #: aggregate readers (JT205)
 _AGG_READERS = {"dict", "list", "tuple", "sorted"}
 _AGG_METHODS = {"items", "values", "keys", "copy"}
+
+#: cross-member membership/routing attributes (JT206): the shared
+#: control-plane state a fleet router derives tenant ownership from
+_MEMBERSHIP_RE = re.compile(
+    r"^_?(members|ring|routing|route_table)$"
+)
+
+
+def _is_membership_attr(node: ast.expr) -> bool:
+    """ATTRIBUTE whose final segment names membership/routing state.
+    Bare Names stay out of scope: a local ``ring = reg.ring()`` is
+    thread-private — only attribute state can be shared."""
+    return isinstance(node, ast.Attribute) and bool(
+        _MEMBERSHIP_RE.match(node.attr)
+    )
+
+
+def _membership_base(node: ast.expr) -> Optional[str]:
+    """The membership attribute a subscript chain bottoms out in:
+    ``self._members[mid]`` -> '_members'."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if _is_membership_attr(node):
+        return node.attr
+    return None
 
 
 def _is_stats_expr(node: ast.expr) -> bool:
@@ -181,6 +215,36 @@ class ConcurrencyChecker(ast.NodeVisitor):
             "its lock — concurrent bumps interleave and drop counts",
         )
 
+    # -- JT206: membership/routing mutation outside the lock -----------
+
+    @property
+    def _in_ctor(self) -> bool:
+        """Inside __init__ (any nesting level): construction is
+        single-threaded — nobody routes over a half-built registry."""
+        return "__init__" in self.symbols
+
+    def _flag_membership(self, node: ast.AST, name: str) -> None:
+        if self.locks or self._in_ctor:
+            return
+        self.add(
+            "JT206", node,
+            f"mutation of cross-member routing state '{name}' "
+            "outside the membership lock — a concurrent router reads "
+            "a half-updated member set/ring and routes one tenant to "
+            "two owners; mutate under the membership lock (rebuild "
+            "rings immutably, swap the reference inside the lock)",
+        )
+
+    def _membership_targets(self, tgt: ast.expr, node: ast.AST):
+        """Flag one assignment/delete target when it rebinds or
+        edits membership state."""
+        if _is_membership_attr(tgt):
+            self._flag_membership(node, tgt.attr)
+        elif isinstance(tgt, ast.Subscript):
+            name = _membership_base(tgt)
+            if name:
+                self._flag_membership(node, name)
+
     def visit_Assign(self, node: ast.Assign) -> None:
         for tgt in node.targets:
             base = (
@@ -190,6 +254,12 @@ class ConcurrencyChecker(ast.NodeVisitor):
             )
             if base:
                 self._flag_mutation(node, base)
+            self._membership_targets(tgt, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._membership_targets(node.target, node)
         self.generic_visit(node)
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:
@@ -200,6 +270,7 @@ class ConcurrencyChecker(ast.NodeVisitor):
         )
         if base:
             self._flag_mutation(node, base)
+        self._membership_targets(node.target, node)
         self.generic_visit(node)
 
     def visit_Delete(self, node: ast.Delete) -> None:
@@ -208,6 +279,7 @@ class ConcurrencyChecker(ast.NodeVisitor):
                 base = _stats_base(tgt)
                 if base:
                     self._flag_mutation(node, base)
+            self._membership_targets(tgt, node)
         self.generic_visit(node)
 
     # -- calls: JT201 mutators, JT202/204 under-lock, JT203, JT205 -----
@@ -239,6 +311,10 @@ class ConcurrencyChecker(ast.NodeVisitor):
             base = _stats_base(node.func.value)
             if base:
                 self._flag_mutation(node, base)
+            # JT206: in-place mutators on membership/routing state
+            mname = _membership_base(node.func.value)
+            if mname:
+                self._flag_membership(node, mname)
 
         # JT205: aggregate reads outside the lock
         if not self.locks:

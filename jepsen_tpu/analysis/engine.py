@@ -176,6 +176,12 @@ RULES: Dict[str, Tuple[str, str]] = {
         "unlocked aggregate stats read",
         "aggregate stats reads go through a locked snapshot() helper",
     ),
+    "JT206": (
+        "membership mutation outside lock",
+        "cross-member membership/routing state (member sets, hash "
+        "rings, route tables) mutates only under the membership "
+        "lock — routers must never read a half-updated ring",
+    ),
     "JT301": (
         "span not context-managed",
         "span(...) is always entered via with — a held span "
@@ -247,7 +253,7 @@ META_RULES: Tuple[str, ...] = ("JT000", "JT001")
 FAMILY_RULES: Dict[str, Tuple[str, ...]] = {
     "A": ("JT101", "JT102", "JT103", "JT104", "JT105", "JT106",
           "JT107"),
-    "B": ("JT201", "JT202", "JT203", "JT204", "JT205"),
+    "B": ("JT201", "JT202", "JT203", "JT204", "JT205", "JT206"),
     "C": ("JT301", "JT302", "JT303", "JT304", "JT305"),
     "D": ("JT401", "JT402", "JT403"),
     "E": ("JT501", "JT502", "JT503"),

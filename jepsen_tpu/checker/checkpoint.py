@@ -68,6 +68,7 @@ CHECKPOINT_STATS = {
     "replays": 0,
     "rejected": 0,
     "invalidations": 0,
+    "handoffs": 0,
     "overhead_s": 0.0,
 }
 
@@ -153,6 +154,14 @@ class CheckpointSink:
     after_save: test hook, called as after_save(sink, state) after
     each durable write — the in-process crash nemesis raises from it
     to simulate death-after-save at a chosen boundary.
+
+    owner: opaque location tag ("member-3") stamped into the durable
+    state. Identity stays pure content hash — the owner is metadata,
+    never part of validation — but a resume whose stored owner
+    differs records a HAND-OFF: the check moved between processes
+    (fleet member died; a survivor inherited its frontier). Surfaced
+    as summary()["resumed_from_owner"] and CHECKPOINT_STATS
+    ["handoffs"] — the fleet's zero-loss evidence.
     """
 
     def __init__(
@@ -161,6 +170,7 @@ class CheckpointSink:
         seg_min_len: Optional[int] = None,
         every: int = 1,
         after_save: Optional[Callable] = None,
+        owner: Optional[str] = None,
     ):
         if os.path.isdir(path):
             path = os.path.join(path, CHECKPOINT_FILE)
@@ -174,10 +184,12 @@ class CheckpointSink:
         self.seg_min_len = seg_min_len
         self.every = max(int(every), 1)
         self.after_save = after_save
+        self.owner = owner
         #: filled by begin()/the driver — summary() reports them
         self.resumed_from = 0
         self.replayed = False
         self.rejected = False
+        self.resumed_from_owner: Optional[str] = None
         self.segments_total = 0
         self._state: Optional[dict] = None
 
@@ -202,8 +214,10 @@ class CheckpointSink:
                     "exact": False,
                     "frontier": None,
                     "verdict": None,
+                    "owner": self.owner,
                 }
             else:
+                prev_owner = st.get("owner")
                 if st.get("verdict") is not None:
                     self.replayed = True
                     _bump("replays")
@@ -213,9 +227,21 @@ class CheckpointSink:
                     self.resumed_from = int(st["segments_done"])
                     _bump("resumes")
                     _bump("resumed_segments", self.resumed_from)
+                    if (prev_owner is not None
+                            and prev_owner != self.owner):
+                        # The frontier was written by a DIFFERENT
+                        # process: a fleet hand-off, not a restart.
+                        self.resumed_from_owner = prev_owner
+                        _bump("handoffs")
+                        obs_trace.instant(
+                            "checkpoint_handoff", kind="checkpoint",
+                            segments=self.resumed_from,
+                        )
                     obs_trace.instant("checkpoint_resume",
                                       kind="checkpoint",
                                       segments=self.resumed_from)
+                # take ownership: the next save stamps the inheritor
+                st["owner"] = self.owner
             self._state = st
             return st
         finally:
@@ -326,10 +352,15 @@ class CheckpointSink:
 
     def summary(self) -> Dict[str, Any]:
         """Per-check checkpoint block for results/engine stats."""
-        return {
+        out = {
             "path": self.path,
             "segments_total": self.segments_total,
             "resumed_from_segment": self.resumed_from,
             "replayed_verdict": self.replayed,
             "rejected_stale": self.rejected,
         }
+        if self.owner is not None:
+            out["owner"] = self.owner
+        if self.resumed_from_owner is not None:
+            out["resumed_from_owner"] = self.resumed_from_owner
+        return out

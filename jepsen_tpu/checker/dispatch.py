@@ -448,6 +448,12 @@ class DispatchPlane:
       worker_join_s: how long close() waits for the async prep worker
         before declaring it leaked and resolving pending futures with
         a PlaneFault.
+      owner: opaque location tag for this plane's process (the fleet
+        member id, "member-3"). Stamped onto any un-owned
+        CheckpointSink that rides submit(), so durable state written
+        through this plane records WHERE it was written — the seam
+        the fleet's hand-off accounting (checkpoint.py `handoffs`)
+        reads when a survivor resumes a dead member's frontier.
     """
 
     def __init__(
@@ -465,6 +471,7 @@ class DispatchPlane:
         worker_join_s: float = 10.0,
         max_inflight_trains: Optional[int] = None,
         host_domain_quarantine: bool = True,
+        owner: Optional[str] = None,
     ):
         from jepsen_tpu.checker.sharded import resolve_mesh
 
@@ -506,6 +513,7 @@ class DispatchPlane:
         #: chip on a mesh spanning >1 host slice ejects its whole
         #: domain. Off = per-chip quarantine only.
         self.host_domain_quarantine = host_domain_quarantine
+        self.owner = owner
         self.mesh = resolve_mesh(mesh)
         #: optional per-future fault attribution hook for multi-tenant
         #: embedders (the service daemon's tenant ledger): called as
@@ -554,6 +562,11 @@ class DispatchPlane:
         the sink (nothing durable to record segment-wise)."""
         fut = CheckFuture(self, events, model or self.model)
         fut.checkpoint = checkpoint
+        if (checkpoint is not None and self.owner is not None
+                and checkpoint.owner is None):
+            # location-stamp un-owned durable state (fleet hand-off
+            # accounting); explicit sink owners always win
+            checkpoint.owner = self.owner
         _bump("requests")
         obs_trace.instant("submit", kind="dispatch",
                           tenant=current_tenant())

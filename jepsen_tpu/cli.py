@@ -613,7 +613,10 @@ def cmd_perf_trend(args) -> int:
     rows (CPU flow validations) and hardware rows (real measurements)
     form separate trajectories, and each mode's latest row is gated
     against ITS OWN predecessor — a CPU smoke geomean is never
-    compared against a TPU hardware one. Exit 1 when any mode's
+    compared against a TPU hardware one. Fleet rows (`--fleet N`
+    bench runs, fleet_size stamped) segregate the same way: each
+    "mode/fleetN" trajectory gates against its own history, never
+    against solo rows. Exit 1 when any trajectory's
     vs_baseline geomean dropped more than --max-regression
     (fractional) below its previous row's, exit 2 when there is no
     ledger to judge. The perf story stays observable ACROSS runs, not
@@ -623,6 +626,7 @@ def cmd_perf_trend(args) -> int:
     from jepsen_tpu.obs.trend import (
         gate_trend,
         load_trend_rows,
+        trend_fleet,
         trend_mode,
     )
 
@@ -648,12 +652,14 @@ def cmd_perf_trend(args) -> int:
             return "-"
         return h[:8] + ("*" if row.get("tuned") else "")
 
-    print(f"{'ts':<20} {'mode':<8} {'cfg':<9} {'vs_base':>8} "
+    print(f"{'ts':<20} {'mode':<8} {'fleet':>5} {'cfg':<9} "
+          f"{'vs_base':>8} "
           f"{'vs_py':>10} {'syncs':>6} {'floor_ms':>9} {'occup':>6} "
           f"{'trace_ov%':>9} {'ops/s':>10}")
     for r in rows:
         ts = str(r.get("ts", "?"))[:19]
         print(f"{ts:<20} {trend_mode(r):<8} "
+              f"{trend_fleet(r):>5} "
               f"{_cfg(r):<9} "
               f"{_num(r, 'vs_baseline'):>8} "
               f"{_num(r, 'vs_python_oracle'):>10} "
@@ -820,10 +826,15 @@ def cmd_daemon(args) -> int:
         drain_s=args.drain_seconds,
         audit_path=args.audit_path,
         audit_max_bytes=args.audit_max_mb << 20,
+        fleet_dir=args.fleet_dir,
+        member_id=args.member_id,
     )
     handle = install_signal_drain(daemon.drain)
+    member = (
+        f" member={daemon.member_id}" if args.fleet_dir else ""
+    )
     print(f"checker daemon serving on {daemon.url} "
-          f"(store={args.store})")
+          f"(store={args.store}){member}")
     try:
         daemon.serve_forever()
     except KeyboardInterrupt:
@@ -832,6 +843,86 @@ def cmd_daemon(args) -> int:
         handle.restore()
         daemon.close()
     print("checker daemon drained. (code 0)")
+    return EXIT_VALID
+
+
+def cmd_fleet(args) -> int:
+    """Run an N-member checker fleet behind one front door.
+
+    Spawns N `daemon` subprocesses on ephemeral ports (each announces
+    its bound URL into the shared fleet dir and heartbeats), waits for
+    the full fleet to come alive, then serves the front door
+    (service/frontdoor.py) in the foreground: consistent-hash tenant
+    routing, admission-shed stealing, and durable hand-off of a dead
+    member's in-flight checks to survivors. SIGTERM drains the fleet:
+    members get SIGTERM first (each drains its own in-flight checks
+    and retires its membership), then the door stops."""
+    import os
+    import time
+
+    from jepsen_tpu.pod import launcher
+    from jepsen_tpu.service.drain import install_signal_drain
+    from jepsen_tpu.service.frontdoor import FleetFrontDoor
+
+    fleet_dir = args.fleet_dir or os.path.join(
+        args.store, ".fleet"
+    )
+    os.makedirs(fleet_dir, exist_ok=True)
+    extra = [
+        "--max-inflight", str(args.max_inflight),
+        "--tenant-inflight", str(args.tenant_inflight),
+        "--coalesce-hold", str(args.coalesce_hold),
+        "--drain-seconds", str(args.drain_seconds),
+    ]
+    procs = [
+        launcher.spawn_fleet_member(
+            i, fleet_dir, args.store,
+            n_local_devices=args.member_devices,
+            extra_args=extra,
+            log_path=os.path.join(fleet_dir, f"member-{i:03d}.log"),
+        )
+        for i in range(args.members)
+    ]
+    try:
+        launcher.wait_fleet(
+            fleet_dir, args.members, timeout_s=args.spawn_timeout
+        )
+    except TimeoutError as e:
+        print(f"fleet: {e}", file=sys.stderr)
+        for p in procs:
+            p.kill()
+        return EXIT_CRASH
+    door = FleetFrontDoor(
+        fleet_dir, host=args.host, port=args.port, mode=args.mode
+    )
+    recovered = door.recover_intents()
+    if recovered:
+        print(f"fleet: recovered {len(recovered)} orphaned "
+              f"intent(s) from a previous door")
+
+    def _drain(signum=None):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()  # member drains + retires itself
+        deadline = time.time() + args.drain_seconds + 5.0
+        for p in procs:
+            try:
+                p.wait(timeout=max(deadline - time.time(), 0.1))
+            except Exception:  # noqa: BLE001 - escalate past drain
+                p.kill()
+        door.shutdown()
+
+    handle = install_signal_drain(_drain)
+    print(f"fleet front door ({args.mode}) on {door.url} — "
+          f"{args.members} members over {fleet_dir}")
+    try:
+        door.serve_forever()
+    except KeyboardInterrupt:
+        _drain()
+    finally:
+        handle.restore()
+        door.close()
+    print("fleet drained. (code 0)")
     return EXIT_VALID
 
 
@@ -1055,7 +1146,51 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--trace", action="store_true",
                    help="enable the flight recorder for the daemon's "
                         "life; GET /trace drains the ring")
+    d.add_argument("--fleet-dir", default=None, metavar="DIR",
+                   help="join a checker fleet: announce + heartbeat "
+                        "this daemon's URL into DIR (the front "
+                        "door's membership registry)")
+    d.add_argument("--member-id", type=int, default=None,
+                   help="this daemon's fleet member id (with "
+                        "--fleet-dir; default 0)")
     d.set_defaults(fn=cmd_daemon)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="N-member checker fleet behind one front door: "
+             "consistent-hash tenant routing, work-stealing, "
+             "zero-loss member hand-off",
+    )
+    shared(fl)
+    fl.add_argument("--members", type=int, default=2,
+                    help="checker-daemon member count (default 2)")
+    fl.add_argument("--host", default="127.0.0.1")
+    fl.add_argument("--port", type=int, default=8010,
+                    help="front-door port (members use ephemeral "
+                         "ports; default 8010)")
+    fl.add_argument("--mode", choices=("proxy", "redirect"),
+                    default="proxy",
+                    help="proxy = relay + journal + steal/hand-off; "
+                         "redirect = 307 to the owning member")
+    fl.add_argument("--fleet-dir", default=None, metavar="DIR",
+                    help="membership registry dir (default "
+                         "<store>/.fleet)")
+    fl.add_argument("--member-devices", type=int, default=4,
+                    help="virtual CPU devices per member (default 4)")
+    fl.add_argument("--max-inflight", type=int, default=64,
+                    help="per-member global in-flight bound")
+    fl.add_argument("--tenant-inflight", type=int, default=16,
+                    help="per-member per-tenant in-flight cap")
+    fl.add_argument("--coalesce-hold", type=float, default=0.005,
+                    metavar="S",
+                    help="per-member coalescing hold window")
+    fl.add_argument("--drain-seconds", type=float, default=10.0,
+                    help="per-member SIGTERM drain budget")
+    fl.add_argument("--spawn-timeout", type=float, default=120.0,
+                    metavar="S",
+                    help="budget for all members to come alive "
+                         "(first launch pays JAX import + compile)")
+    fl.set_defaults(fn=cmd_fleet)
     return p
 
 

@@ -9,6 +9,13 @@ CPU smoke geomean around 2.5 against a TPU hardware geomean around 11
 is not a regression, it is a category error — so every comparison in
 this module is WITHIN one mode's trajectory, never across. Rows from
 before the mode field infer it from the older ``smoke`` bool.
+
+Rows may additionally carry ``fleet_size`` (the PR 18 fleet bench
+stamps the member count; solo rows omit it and default to 1). A
+2-member fleet's aggregate throughput against a solo daemon's is the
+same category error as smoke-vs-hardware, so trajectories key on
+(mode, fleet_size) — rendered as "smoke/fleet2" — and each is gated
+against its own history only.
 """
 
 from __future__ import annotations
@@ -51,6 +58,25 @@ def trend_mode(row: dict) -> str:
     return "smoke" if row.get("smoke") else "hardware"
 
 
+def trend_fleet(row: dict) -> int:
+    """A row's fleet size: the stamped member count, 1 (solo) when
+    absent or unparseable — every pre-fleet row is a solo row."""
+    try:
+        n = int(row.get("fleet_size", 1))
+    except (TypeError, ValueError):
+        return 1
+    return n if n >= 1 else 1
+
+
+def trend_key(row: dict) -> str:
+    """The trajectory a row belongs to: its mode, suffixed with the
+    fleet size when fleeted ("smoke/fleet2"). Solo rows keep the bare
+    mode, so existing single-daemon trajectories are unbroken."""
+    n = trend_fleet(row)
+    mode = trend_mode(row)
+    return mode if n == 1 else f"{mode}/fleet{n}"
+
+
 def drift_attribution(prev: dict, cur: dict) -> str:
     """Classify a regression between two adjacent rows: when both
     carry the perf plane's ``config_hash``, a hash change means the
@@ -70,16 +96,17 @@ def drift_attribution(prev: dict, cur: dict) -> str:
 def gate_trend(
     rows: List[dict], max_regression: float
 ) -> Tuple[bool, List[str]]:
-    """The regression gate, per mode: within each mode's trajectory,
-    the latest row's vs_baseline geomean must not sit more than
-    ``max_regression`` (fractional) below its predecessor's. Returns
-    (ok, messages) — ok False when ANY mode's trajectory regressed.
-    Trajectories with under two comparable rows pass vacuously (the
-    message says so). Regression messages carry a drift attribution
-    (config vs code) from the rows' config_hash stamps."""
+    """The regression gate, per trajectory: within each (mode,
+    fleet_size) trajectory, the latest row's vs_baseline geomean must
+    not sit more than ``max_regression`` (fractional) below its
+    predecessor's. Returns (ok, messages) — ok False when ANY
+    trajectory regressed. Trajectories with under two comparable rows
+    pass vacuously (the message says so). Regression messages carry a
+    drift attribution (config vs code) from the rows' config_hash
+    stamps."""
     by_mode: dict = {}
     for r in rows:
-        by_mode.setdefault(trend_mode(r), []).append(r)
+        by_mode.setdefault(trend_key(r), []).append(r)
     ok = True
     msgs: List[str] = []
     for mode in sorted(by_mode):

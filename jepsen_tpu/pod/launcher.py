@@ -98,6 +98,103 @@ def pod_env(
     return env
 
 
+def member_env(
+    n_local_devices: int = 4,
+    base_env: Optional[Dict[str, str]] = None,
+) -> Dict[str, str]:
+    """The env one FLEET member needs: a CPU backend with its own
+    virtual chips, the repo importable, and the shared compile cache —
+    ``pod_env`` minus the pod-coordinator seam. Fleet members are
+    independent planes (each owns its own mesh over its own process's
+    devices); the pod seam would make every member block in
+    ``init_pod`` waiting for a collective peer it must not have."""
+    env = dict(os.environ if base_env is None else base_env)
+    # a fleet member must NOT inherit a pod identity from a pod-member
+    # parent: scrub the seam so topology sees a solo process
+    for k in (
+        topology.ENV_COORDINATOR,
+        topology.ENV_NPROCS,
+        topology.ENV_PROCESS_ID,
+    ):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={int(n_local_devices)}"
+    )
+    env["PYTHONPATH"] = (
+        _repo_root() + os.pathsep + env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    from jepsen_tpu.perf.autotune import compile_cache_dir
+
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", compile_cache_dir())
+    return env
+
+
+def spawn_fleet_member(
+    member_id: int,
+    fleet_dir: str,
+    root: str,
+    *,
+    n_local_devices: int = 4,
+    interpret: bool = True,
+    python: Optional[str] = None,
+    extra_args: Optional[List[str]] = None,
+    extra_env: Optional[Dict[str, str]] = None,
+    log_path: Optional[str] = None,
+) -> subprocess.Popen:
+    """Spawn ONE checker-daemon fleet member as a subprocess on an
+    ephemeral port. The member announces its bound URL into
+    ``fleet_dir`` itself (service/membership.py), so the parent
+    discovers it through the registry rather than picking ports —
+    poll ``wait_fleet`` for readiness. The caller owns the process
+    (terminate/kill/wait); SIGKILL-ing one is the fleet durability
+    drill, and the front door declares the death on first contact."""
+    env = member_env(n_local_devices)
+    if interpret:
+        env["JEPSEN_TPU_INTERPRET"] = "1"
+    if extra_env:
+        env.update(extra_env)
+    cmd = [
+        python or sys.executable, "-m", "jepsen_tpu.cli", "daemon",
+        "--store", root, "--port", "0",
+        "--fleet-dir", fleet_dir, "--member-id", str(member_id),
+    ]
+    cmd += list(extra_args or [])
+    logf = open(log_path, "ab") if log_path else subprocess.DEVNULL
+    try:
+        return subprocess.Popen(
+            cmd, env=env, stdout=logf, stderr=logf,
+            cwd=_repo_root(),
+        )
+    finally:
+        if log_path:
+            logf.close()
+
+
+def wait_fleet(
+    fleet_dir: str, n_members: int, timeout_s: float = 90.0
+) -> list:
+    """Block until ``n_members`` members are announced + alive in
+    ``fleet_dir`` (or raise TimeoutError). Returns their MemberInfo
+    rows. First-launch members pay JAX import + first compile before
+    they bind, so the default budget is generous; warm spawns clear
+    it in a couple of seconds."""
+    from jepsen_tpu.service.membership import FleetRegistry
+
+    reg = FleetRegistry(fleet_dir)
+    deadline = time.monotonic() + timeout_s
+    while True:
+        alive = reg.alive_members()
+        if len(alive) >= n_members:
+            return alive
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"fleet incomplete: {len(alive)}/{n_members} members "
+                f"alive in {fleet_dir} after {timeout_s:.0f}s"
+            )
+        time.sleep(0.1)
+
+
 def launch_pod(
     n_procs: int,
     script: str,

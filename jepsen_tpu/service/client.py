@@ -1,29 +1,51 @@
-"""Stdlib client for the checker daemon.
+"""Stdlib client for the checker daemon — and for the fleet.
 
-One ``CheckerClient`` speaks to one daemon as one tenant. ``check()``
+One ``CheckerClient`` speaks to one address as one tenant. ``check()``
 serializes a history (a History, a list of Ops, or already-encoded
 dicts) through the store's canonical op JSON, POSTs it with the
 tenant header, and returns the verdict dict — raising ServiceError
-for every non-200, with bounded exponential backoff on the two
-retryable refusals (429 shed, 503 draining): backpressure the daemon
-emits becomes polite retry here, not a hot loop.
+for every non-200, with JITTERED bounded exponential backoff on the
+two retryable refusals (429 shed, 503 draining): backpressure the
+daemon emits becomes polite retry here, not a hot loop, and the
+jitter decorrelates a thundering herd of clients retrying into a
+recovering member at the same instant. When the response carries a
+``Retry-After`` header (the fleet front door's all-members-loaded
+estimate, or any member's own), that wait wins over the computed
+backoff — the server knows its recovery horizon better than the
+client's doubling schedule does.
+
+Fleet-aware: a 307/308 answer (the front door's ``mode="redirect"``
+stance) is followed to its ``Location`` — method + body preserved, so
+the re-POST carries the same bytes and lands the same durable check
+id at the owner. Redirect hops are bounded and not charged against
+the retry budget; a retryable refusal AFTER a redirect retries at the
+ORIGINAL address (the front door re-routes — the shed member's load
+is exactly why the ring should pick again).
 
 bench.py routes through this client to measure the warm-plane vs
-cold-process delta; the tests use it as the tenant-side half of every
-service scenario.
+cold-process delta (and the fleet scale-out delta); the tests use it
+as the tenant-side half of every service scenario.
 """
 
 from __future__ import annotations
 
 import http.client
 import json
+import random
 import time
+import urllib.parse
 from typing import Any, Iterable, Optional
 
 from jepsen_tpu.service.tenants import DEFAULT_TENANT
 
 #: refusals worth retrying — shed (429) and draining (503)
 RETRYABLE = frozenset({429, 503})
+
+#: fleet redirect statuses worth following (method/body-preserving)
+REDIRECT = frozenset({307, 308})
+
+#: redirect-chain bound — a routing loop fails fast, not forever
+MAX_REDIRECTS = 4
 
 
 class ServiceError(Exception):
@@ -68,13 +90,18 @@ class CheckerClient:
     # -- transport -----------------------------------------------------
 
     def _request(
-        self, method: str, path: str, body: Optional[bytes] = None
+        self, method: str, path: str, body: Optional[bytes] = None,
+        host: Optional[str] = None, port: Optional[int] = None,
     ) -> tuple:
-        """(status, decoded json) for one HTTP round trip; a fresh
-        connection per request keeps the client free of pooled-socket
-        state across daemon restarts (the drain tests kill daemons)."""
+        """(status, decoded json, response headers) for one HTTP
+        round trip; a fresh connection per request keeps the client
+        free of pooled-socket state across daemon restarts (the drain
+        tests kill daemons). host/port override the constructor's for
+        one hop — the redirect-following leg."""
         conn = http.client.HTTPConnection(
-            self.host, self.port, timeout=self.timeout_s
+            host or self.host,
+            self.port if port is None else port,
+            timeout=self.timeout_s,
         )
         try:
             headers = {"X-Tenant": self.tenant}
@@ -88,25 +115,74 @@ class CheckerClient:
                 obj = json.loads(raw) if raw else {}
             except ValueError:
                 obj = {"detail": raw.decode(errors="replace")}
-            return resp.status, obj
+            return resp.status, obj, dict(resp.getheaders())
         finally:
             conn.close()
+
+    @staticmethod
+    def _retry_after(headers: dict) -> Optional[float]:
+        """The server's own backoff estimate, when parseable (the
+        delta-seconds form; HTTP-date is not worth a date parser on a
+        localhost control plane)."""
+        for k, v in headers.items():
+            if k.lower() == "retry-after":
+                try:
+                    return max(float(v), 0.0)
+                except (TypeError, ValueError):
+                    return None
+        return None
 
     def _roundtrip(self, method: str, path: str,
                    body: Optional[bytes] = None) -> dict:
         delay = self.backoff_s
-        for attempt in range(self.retries + 1):
-            status, obj = self._request(method, path, body)
+        target = (None, None, path)  # (host, port, path) overrides
+        hops = 0
+        attempt = 0
+        while True:
+            host, port, p = target
+            status, obj, headers = self._request(
+                method, p, body, host=host, port=port
+            )
+            if status in REDIRECT and hops < MAX_REDIRECTS:
+                loc = headers.get("Location") or headers.get(
+                    "location"
+                )
+                if loc:
+                    # Follow the fleet's routing answer: same method,
+                    # same bytes, the owner's address. Not charged as
+                    # a retry — nothing was refused.
+                    u = urllib.parse.urlparse(loc)
+                    target = (
+                        u.hostname or host,
+                        u.port if u.port is not None else port,
+                        u.path or p,
+                    )
+                    hops += 1
+                    continue
             if status == 200:
                 return obj
             if status in RETRYABLE and attempt < self.retries:
-                time.sleep(delay)
+                ra = self._retry_after(headers)
+                if ra is not None:
+                    # honor the server's estimate, decorrelated with
+                    # up to 25% jitter ON TOP (never below it)
+                    wait = ra * random.uniform(1.0, 1.25)
+                else:
+                    # full-jitter exponential: mean half the doubling
+                    # schedule, zero synchronization between clients
+                    wait = random.uniform(0.0, delay)
+                time.sleep(wait)
                 delay *= 2
+                attempt += 1
+                # a shed AFTER a redirect retries at the original
+                # address: the front door should re-route (the owner
+                # that shed is exactly the member to avoid)
+                target = (None, None, path)
+                hops = 0
                 continue
             raise ServiceError(
                 status, obj.get("error", "error"), obj
             )
-        raise AssertionError("unreachable")
 
     # -- API -----------------------------------------------------------
 

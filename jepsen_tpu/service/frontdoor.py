@@ -1,0 +1,467 @@
+"""The fleet front door: one address in front of N checker daemons.
+
+Tenants shard across the fleet by consistent hashing on the tenant id
+(``service/membership.py``): every request for tenant T lands on the
+same member while membership is stable, so T's admission ledger,
+breaker strikes, and stream state live in exactly one place —
+member-local ledgers stay authoritative, the front door never
+second-guesses an admission verdict. Two stances:
+
+- ``mode="proxy"`` (default): thin forwarding proxy. The door reads
+  the request once, journals a durable *intent* record for /check
+  bodies (tmp+rename under ``<fleet_dir>/intents/``), forwards to the
+  owner, relays the answer, then retires the intent. The journal plus
+  ``check_id_for`` content identity is the zero-loss story: if the
+  owner dies mid-check the door declares the death (quarantine
+  ladder) and replays the SAME bytes to the next member on the ring —
+  same bytes, same check id, same checkpoint file under the shared
+  store root, so a durable check RESUMES from the dead member's last
+  verified frontier instead of restarting.
+- ``mode="redirect"``: 307 + ``Location`` to the owner. Zero relay
+  cost, the client re-POSTs (307 preserves method/body); pair with a
+  client that follows redirects (``service/client.py`` does).
+
+Work-stealing rides the same path: the member-local admission door
+answering 429 means the owner's queue is full — the check is queued-
+but-unstarted, so the front door forwards it to the owner's ring
+successors instead (a *steal*: the hot tenant's overflow runs on idle
+members instead of shedding). 503 (owner draining) steals the same
+way. Only when EVERY alive member sheds does the client see 429/503 —
+with a ``Retry-After`` header, so the fleet client's jittered backoff
+honors the fleet's own estimate instead of stampeding.
+
+Streams are sticky (no steal): a stream's incremental frontier lives
+on its owner, so /check/stream follows the ring and fails over only
+on owner death — a durable stream replayed from the start resumes
+from its persisted frontier on the new owner, same as solo restarts.
+
+The door itself keeps NO tenant state: everything it knows is
+re-derivable from the fleet dir + quarantine ledger, so the door is
+restartable and (because intents are durable) its death mid-flight
+loses nothing either — ``recover_intents`` replays orphans on start.
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+import logging
+import os
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+from jepsen_tpu.service.membership import FleetRegistry, MemberInfo
+
+log = logging.getLogger("jepsen_tpu.service.fleet")
+
+#: statuses meaning "the member's admission door shed this" — the
+#: steal trigger (429 queue/tenant caps, 503 draining)
+SHED = (429, 503)
+
+#: what the door tells an all-shed client to wait (seconds)
+RETRY_AFTER_S = 1
+
+#: per-forward socket timeout: covers the member's full check wall
+#: time in proxy mode (durable checks can run many segments)
+DEFAULT_FORWARD_TIMEOUT_S = 120.0
+
+
+def _fleet_counters() -> dict:
+    return {
+        "routed": 0,        # requests that reached routing
+        "proxied": 0,       # forwarded + relayed in proxy mode
+        "redirects": 0,     # 307s issued in redirect mode
+        "steals": 0,        # shed by owner, accepted by a successor
+        "handoffs": 0,      # owner died mid-flight, replayed onward
+        "member_deaths": 0, # deaths this door declared
+        "exhausted": 0,     # every alive member shed or died
+        "intents_recovered": 0,
+    }
+
+
+class FleetFrontDoor:
+    """The routing tier (module docstring). Construct with the same
+    ``fleet_dir`` the members announce into; ``serve_forever`` from a
+    thread or the `cli.py fleet` foreground."""
+
+    def __init__(
+        self,
+        fleet_dir: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        mode: str = "proxy",
+        forward_timeout_s: float = DEFAULT_FORWARD_TIMEOUT_S,
+        ttl_s: Optional[float] = None,
+    ):
+        if mode not in ("proxy", "redirect"):
+            raise ValueError(f"unknown front-door mode: {mode!r}")
+        self.mode = mode
+        self.forward_timeout_s = float(forward_timeout_s)
+        kw = {} if ttl_s is None else {"ttl_s": ttl_s}
+        self.registry = FleetRegistry(fleet_dir, **kw)
+        self.intent_dir = os.path.join(fleet_dir, "intents")
+        os.makedirs(self.intent_dir, exist_ok=True)
+        self._stats_lock = threading.Lock()
+        self._counters = _fleet_counters()
+        self.started_at = time.time()
+        handler = type(
+            "FleetHandler", (_FleetHandler,), {"door": self}
+        )
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.host, self.port = self.httpd.server_address[:2]
+
+    # -- lifecycle -----------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def serve_forever(self) -> None:
+        log.info(
+            "fleet front door (%s) on %s over %s",
+            self.mode, self.url, self.registry.fleet_dir,
+        )
+        self.httpd.serve_forever(poll_interval=0.1)
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+
+    def close(self) -> None:
+        try:
+            self.httpd.server_close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "FleetFrontDoor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self._counters[key] += n
+
+    # -- the durable intent journal ------------------------------------
+
+    def _intent_path(self, tenant: str, body: bytes) -> str:
+        from jepsen_tpu.service.server import check_id_for
+
+        slug = "".join(
+            c if c.isalnum() or c in "-_" else "_" for c in tenant
+        )
+        return os.path.join(
+            self.intent_dir,
+            f"{slug}-{check_id_for('intent', body)}.json",
+        )
+
+    def journal_intent(
+        self, tenant: str, path: str, body: bytes
+    ) -> str:
+        """Durably record 'this check was accepted by the fleet'
+        BEFORE any member sees it. Content-keyed, so a client retry
+        of the same bytes overwrites (idempotent) instead of piling
+        up. Retired by ``retire_intent`` once a member answered."""
+        from jepsen_tpu.store import atomic_write_text
+
+        p = self._intent_path(tenant, body)
+        atomic_write_text(p, json.dumps({
+            "tenant": tenant,
+            "path": path,
+            "body_b64": base64.b64encode(body).decode(),
+            "ts": time.time(),
+        }))
+        return p
+
+    def retire_intent(self, intent_path: Optional[str]) -> None:
+        if not intent_path:
+            return
+        try:
+            os.unlink(intent_path)
+        except OSError:
+            pass
+
+    def recover_intents(self) -> List[Tuple[int, dict]]:
+        """Replay every orphaned intent (accepted by a door that died
+        before a member answered) through the current fleet. Returns
+        the (status, verdict) per intent; zero-loss means none are
+        silently dropped — an intent that still cannot run stays
+        journaled for the next recovery pass."""
+        out: List[Tuple[int, dict]] = []
+        try:
+            names = sorted(os.listdir(self.intent_dir))
+        except OSError:
+            return out
+        for name in names:
+            p = os.path.join(self.intent_dir, name)
+            try:
+                with open(p, encoding="utf-8") as f:
+                    d = json.load(f)
+                body = base64.b64decode(d["body_b64"])
+                tenant, req_path = d["tenant"], d["path"]
+            except (OSError, ValueError, KeyError):
+                continue  # torn journal file: not an intent
+            status, obj, _ = self.dispatch(
+                tenant, req_path, body, journal=False
+            )
+            if status < 500 and status not in SHED:
+                self.retire_intent(p)
+                self._bump("intents_recovered")
+            out.append((status, obj))
+        return out
+
+    # -- forwarding ----------------------------------------------------
+
+    def _forward(
+        self, member: MemberInfo, tenant: str, path: str,
+        body: bytes,
+    ) -> Tuple[int, dict]:
+        """One POST relayed to one member. Raises OSError-family on a
+        dead member (the caller's death/hand-off trigger)."""
+        u = urllib.parse.urlparse(member.url)
+        conn = http.client.HTTPConnection(
+            u.hostname, u.port, timeout=self.forward_timeout_s
+        )
+        try:
+            conn.request("POST", path, body=body, headers={
+                "Content-Type": "application/json",
+                "Content-Length": str(len(body)),
+                "X-Tenant": tenant,
+            })
+            resp = conn.getresponse()
+            raw = resp.read()
+        finally:
+            conn.close()
+        try:
+            obj = json.loads(raw) if raw else {}
+        except ValueError:
+            obj = {"error": "bad-upstream-json"}
+        return resp.status, obj
+
+    def _fetch_member_json(
+        self, member: MemberInfo, path: str, timeout_s: float = 5.0
+    ) -> Optional[dict]:
+        u = urllib.parse.urlparse(member.url)
+        try:
+            conn = http.client.HTTPConnection(
+                u.hostname, u.port, timeout=timeout_s
+            )
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                raw = resp.read()
+            finally:
+                conn.close()
+            return json.loads(raw)
+        except (OSError, ValueError):
+            return None
+
+    def dispatch(
+        self, tenant: str, path: str, body: bytes,
+        journal: bool = True,
+    ) -> Tuple[int, dict, Optional[int]]:
+        """Route one POST through the fleet: (status, response obj,
+        serving member id). Owner first; shed → steal to successors;
+        dead → quarantine + hand off the same bytes onward. Streams
+        (path /check/stream) are sticky: owner or fail-over only,
+        never stolen — their incremental state is member-local."""
+        self._bump("routed")
+        order = self.registry.route_order(tenant)
+        if not order:
+            return 503, {
+                "error": "fleet-empty",
+                "detail": "no alive members in the fleet",
+            }, None
+        sticky = path.endswith("/stream")
+        intent = None
+        if journal and not sticky:
+            intent = self.journal_intent(tenant, path, body)
+        shed_status, shed_obj = None, None
+        for i, member in enumerate(order):
+            try:
+                status, obj = self._forward(
+                    member, tenant, path, body
+                )
+            except OSError:
+                # The owner (or a successor) died on the wire: one
+                # declaration ejects it fleet-wide, and the SAME
+                # bytes move to the next ring member — content-hash
+                # identity turns this into a checkpoint resume for
+                # durable checks.
+                log.warning(
+                    "member %d dead on the wire; handing off",
+                    member.member_id,
+                )
+                self.registry.note_member_death(member.member_id)
+                self._bump("member_deaths")
+                if i + 1 < len(order):
+                    self._bump("handoffs")
+                continue
+            if status in SHED and not sticky:
+                # Member-local admission is authoritative: the owner
+                # shed, so the check is queued-but-unstarted there.
+                # Steal it to the next successor instead of shedding
+                # the whole fleet.
+                shed_status, shed_obj = status, obj
+                continue
+            if i > 0 and shed_status is not None:
+                self._bump("steals")
+            if status < 500 and status not in SHED:
+                self.retire_intent(intent)
+            obj["fleet_member"] = member.member_id
+            return status, obj, member.member_id
+        self._bump("exhausted")
+        if shed_status is not None:
+            # every alive member shed: relay the last member verdict,
+            # stamped with the fleet's own backoff estimate
+            shed_obj["fleet_exhausted"] = True
+            return shed_status, shed_obj, None
+        self.retire_intent(intent)  # unroutable, not re-runnable
+        return 503, {
+            "error": "fleet-unavailable",
+            "detail": "all members dead or unreachable",
+        }, None
+
+    # -- observability -------------------------------------------------
+
+    def fleet_stats(self) -> dict:
+        """The per-member /stats rollup: each alive member's counters
+        that the fleet bench gates on (completed checks, host syncs,
+        launches), summed fleet-wide, plus the door's own routing
+        counters and the membership snapshot."""
+        members = {}
+        rollup = {
+            "completed": 0, "valid": 0, "invalid": 0,
+            "host_syncs": 0, "launches": 0,
+        }
+        for m in self.registry.alive_members():
+            s = self._fetch_member_json(m, "/stats")
+            if s is None:
+                continue
+            tenants = s.get("tenants") or {}
+            completed = sum(
+                int(row.get("completed", 0))
+                for row in tenants.values()
+            )
+            valid = sum(
+                int(row.get("valid", 0)) for row in tenants.values()
+            )
+            invalid = sum(
+                int(row.get("invalid", 0))
+                for row in tenants.values()
+            )
+            launch = s.get("launch") or {}
+            row = {
+                "url": m.url,
+                "completed": completed,
+                "valid": valid,
+                "invalid": invalid,
+                "host_syncs": int(launch.get("host_syncs", 0)),
+                "launches": int(launch.get("launches", 0)),
+                "draining": bool(s.get("draining")),
+                "uptime_s": s.get("uptime_s"),
+            }
+            members[str(m.member_id)] = row
+            rollup["completed"] += completed
+            rollup["valid"] += valid
+            rollup["invalid"] += invalid
+            rollup["host_syncs"] += row["host_syncs"]
+            rollup["launches"] += row["launches"]
+        with self._stats_lock:
+            counters = dict(self._counters)
+        return {
+            "mode": self.mode,
+            "uptime_s": time.time() - self.started_at,
+            "door": counters,
+            "members": members,
+            "rollup": rollup,
+            "membership": self.registry.snapshot(),
+        }
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    door: FleetFrontDoor  # bound by FleetFrontDoor.__init__
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send_json(
+        self, code: int, obj: dict, headers: Optional[dict] = None
+    ) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _tenant(self) -> str:
+        from jepsen_tpu.service.tenants import DEFAULT_TENANT
+
+        t = (self.headers.get("X-Tenant") or "").strip()
+        return t or DEFAULT_TENANT
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        d = self.door
+        if self.path == "/healthz":
+            self._send_json(200, {
+                "ok": True,
+                "role": "frontdoor",
+                "mode": d.mode,
+                "members_alive": len(d.registry.alive_members()),
+                "uptime_s": time.time() - d.started_at,
+            })
+            return
+        if self.path == "/fleet":
+            self._send_json(200, d.registry.snapshot())
+            return
+        if self.path == "/stats":
+            self._send_json(200, d.fleet_stats())
+            return
+        self._send_json(404, {"error": "not-found"})
+
+    def do_POST(self):  # noqa: N802 (stdlib API)
+        d = self.door
+        if self.path not in ("/check", "/check/stream"):
+            self._send_json(404, {"error": "not-found"})
+            return
+        tenant = self._tenant()
+        cl = self.headers.get("Content-Length")
+        if cl is None:
+            self._send_json(411, {"error": "length-required"})
+            return
+        body = self.rfile.read(int(cl))
+        if d.mode == "redirect":
+            member = d.registry.route(tenant)
+            d._bump("routed")
+            if member is None:
+                self._send_json(
+                    503, {"error": "fleet-empty"},
+                    headers={"Retry-After": str(RETRY_AFTER_S)},
+                )
+                return
+            d._bump("redirects")
+            # 307 preserves method + body; the fleet client re-POSTs
+            # the same bytes at the owner (same check id — durable
+            # identity survives the extra hop).
+            self._send_json(
+                307,
+                {"redirect": member.url + self.path,
+                 "fleet_member": member.member_id},
+                headers={"Location": member.url + self.path},
+            )
+            return
+        status, obj, _mid = d.dispatch(tenant, self.path, body)
+        headers = (
+            {"Retry-After": str(RETRY_AFTER_S)}
+            if status in SHED else None
+        )
+        d._bump("proxied")
+        self._send_json(status, obj, headers=headers)

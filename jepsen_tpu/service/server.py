@@ -167,6 +167,9 @@ class CheckerDaemon:
         drain_s: float = 10.0,
         audit_path: Optional[str] = None,
         audit_max_bytes: int = 4 * 1024 * 1024,
+        fleet_dir: Optional[str] = None,
+        member_id: Optional[int] = None,
+        own_plane: bool = True,
     ):
         if interpret is None:
             interpret = os.environ.get(
@@ -194,15 +197,36 @@ class CheckerDaemon:
             per_tenant_inflight=per_tenant_inflight,
             max_payload_bytes=max_payload_bytes,
         )
-        # Own the process-wide plane: mesh + memo + compile caches live
-        # for the daemon's life; every tenant's checks share them.
-        dispatch.reset_default_plane()
-        self.plane = dispatch.default_plane(
-            model=model,
-            interpret=interpret,
-            launch_deadline_s=launch_deadline_s,
+        #: fleet identity (None when solo) — tagged into durable
+        #: checkpoint state so a hand-off resume is attributable
+        if fleet_dir is not None and member_id is None:
+            member_id = 0
+        self.member_id = member_id
+        self.fleet_dir = fleet_dir
+        self._registry = None
+        owner = (
+            f"member-{member_id}" if member_id is not None else None
         )
-        self.plane.fault_observer = self.ledger.observe_plane
+        if own_plane:
+            # Own the process-wide plane: mesh + memo + compile caches
+            # live for the daemon's life; every tenant's checks share
+            # them.
+            dispatch.reset_default_plane()
+            self.plane = dispatch.default_plane(
+                model=model,
+                interpret=interpret,
+                launch_deadline_s=launch_deadline_s,
+                owner=owner,
+            )
+            self.plane.fault_observer = self.ledger.observe_plane
+        else:
+            # In-process fleet tests run N daemons in ONE process:
+            # they share the already-built default plane instead of
+            # fighting over resets (last reset would orphan every
+            # sibling's plane). Per-member owner stamping moves to the
+            # sink construction in handle_check.
+            self.plane = dispatch.default_plane()
+        self._owner = owner
         self.started_at = time.time()
         #: live streaming checks, keyed (tenant, stream_id) — each
         #: holds a checker/streaming.py StreamingCheck that chunked
@@ -215,6 +239,17 @@ class CheckerDaemon:
         )
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self.httpd.server_address[:2]
+        if fleet_dir is not None:
+            # Fleet membership: announce AFTER the bind (the URL in
+            # the member file must be connectable the moment a router
+            # reads it), then heartbeat until drain/close.
+            from jepsen_tpu.service.membership import FleetRegistry
+
+            self._registry = FleetRegistry(
+                fleet_dir, member_id=member_id, url=self.url
+            )
+            self._registry.announce()
+            self._registry.start_heartbeat()
 
     # -- lifecycle -----------------------------------------------------
 
@@ -239,6 +274,12 @@ class CheckerDaemon:
             "%.1fs for in-flight checks",
             f" (signal {signum})" if signum else "", self.drain_s,
         )
+        if self._registry is not None:
+            # Routers skip draining members immediately (no TTL wait)
+            try:
+                self._registry.announce(draining=True)
+            except OSError:
+                pass
         self.admission.start_drain()
         clean = self.admission.wait_idle(self.drain_s)
         if not clean:
@@ -253,6 +294,8 @@ class CheckerDaemon:
     def close(self) -> None:
         """Release the socket. The default plane stays up (it is
         process-wide); tests that cycle daemons reset it themselves."""
+        if self._registry is not None:
+            self._registry.retire()
         try:
             self.httpd.server_close()
         except OSError:
@@ -273,13 +316,23 @@ class CheckerDaemon:
         # the consolidated engine snapshot (dispatch/launch/mesh/
         # resilience/checkpoint/streaming/txn_graph/trace) plus the
         # service-only surfaces layered on top
-        return {
+        out = {
             **engine_snapshot(),
             "tenants": self.ledger.snapshot(),
             "admission": self.admission.snapshot(),
             "uptime_s": time.time() - self.started_at,
             "draining": self.admission.draining,
         }
+        if self.member_id is not None:
+            # fleet identity block: the front door's /stats rollup
+            # and the fleet bench key their per-member rows on this
+            out["member"] = {
+                "member_id": self.member_id,
+                "fleet_dir": self.fleet_dir,
+                "url": self.url,
+                "pid": os.getpid(),
+            }
+        return out
 
     def checkpoint_path(self, tenant: str, check_id: str) -> str:
         return self.store.service_checkpoint_path(tenant, check_id)
@@ -362,6 +415,7 @@ class CheckerDaemon:
                     sink = CheckpointSink(
                         self.checkpoint_path(tenant, check_id),
                         seg_min_len=int(seg_env) if seg_env else None,
+                        owner=self._owner,
                     )
                     out = checker.check({}, history, checkpoint=sink)
                     if sink.resumed_from > 0:

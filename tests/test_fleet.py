@@ -1,0 +1,518 @@
+"""Fleet scale-out tests (service/membership.py, service/frontdoor.py
++ the hand-off seams in checker/checkpoint.py and service/server.py).
+
+The contract under test, per PR 18 surface:
+
+- consistent hashing: the ring routes deterministically, spreads
+  tenants within a small factor of uniform, and a membership change
+  moves ONLY the dead/joined member's tenant share (minimal churn).
+- membership: announce/heartbeat/TTL/draining/retire through the
+  shared fleet dir; torn member files are skipped, not fatal; death
+  rides the same quarantine ladder as pod host death — one label
+  removes a member from routing with no TTL wait.
+- the front door: proxy mode relays with verdict parity and stamps
+  the serving member; routing is sticky per tenant; a shedding owner
+  has its check STOLEN by a ring successor instead of shedding the
+  fleet; redirect mode 307s and the client follows; /stats rolls up
+  per-member counters.
+- zero-loss hand-off: same bytes → same check id → same checkpoint
+  path under the shared store root, so a check that died on member A
+  resumes from A's durable frontier when member B inherits it —
+  strictly fewer launches, identical verdict, and the takeover is
+  visible (resumed_from_owner + the handoffs counter).
+
+Everything here is in-process and tier-1 (Pallas interpret mode);
+the subprocess SIGKILL fleet drill lives in tools/fleet-smoke.sh.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu.checker import chaos, dispatch
+from jepsen_tpu.checker import wgl_bitset as bs
+from jepsen_tpu.checker.checkpoint import (
+    CheckpointSink,
+    checkpoint_stats,
+    reset_checkpoint_stats,
+)
+from jepsen_tpu.checker.linearizable import LinearizableChecker
+from jepsen_tpu.service.client import CheckerClient, ServiceError
+from jepsen_tpu.service.frontdoor import FleetFrontDoor
+from jepsen_tpu.service.membership import (
+    FleetRegistry,
+    HashRing,
+    member_label,
+    tenant_spread,
+)
+from jepsen_tpu.service.server import CheckerDaemon, check_id_for
+from jepsen_tpu.store import Store
+from test_checkpoint import _steps, burst_history
+from test_service import _client, _register, _strip
+
+pytestmark = pytest.mark.fleet
+
+
+def _fstrip(out):
+    """_strip plus the door's fleet_member stamp: what must equal a
+    local checker run byte-for-byte."""
+    return _strip(
+        {k: v for k, v in out.items() if k != "fleet_member"}
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    """Every fleet test quarantines members through the shared
+    resilience ledger; never leak a dead member into the next test."""
+    yield
+    chaos.reset_resilience()
+
+
+@pytest.fixture
+def small_w(monkeypatch):
+    """test_checkpoint's speed seam: narrow W buckets keep the
+    multi-segment hand-off recipe cheap in tier-1."""
+    monkeypatch.setattr(bs, "W_BUCKETS", (4, 5) + bs.W_BUCKETS)
+
+
+# -- the hash ring ----------------------------------------------------
+
+
+def test_ring_routes_deterministically_and_covers_members():
+    ring = HashRing([0, 1, 2, 3])
+    assert len(ring) == 4 and ring.member_ids == (0, 1, 2, 3)
+    for t in ("alice", "bob", "t-17"):
+        assert ring.route(t) == ring.route(t)
+        order = ring.successors(t)
+        assert order[0] == ring.route(t)
+        assert sorted(order) == [0, 1, 2, 3]  # all, distinct
+    # a rebuilt ring is the same ring: routing is pure content hash
+    again = HashRing([3, 2, 1, 0])
+    assert all(
+        ring.route(f"t{i}") == again.route(f"t{i}")
+        for i in range(200)
+    )
+
+
+def test_ring_spreads_tenants_and_empty_ring_routes_none():
+    ring = HashRing(range(4))
+    spread = tenant_spread(ring, [f"tenant-{i}" for i in range(1000)])
+    assert sum(spread.values()) == 1000
+    assert set(spread) == {0, 1, 2, 3}  # nobody starved
+    assert max(spread.values()) / (1000 / 4) < 1.6  # rough uniformity
+    empty = HashRing([])
+    assert empty.route("anyone") is None
+    assert empty.successors("anyone") == []
+    assert len(empty) == 0
+
+
+def test_ring_membership_change_moves_only_the_lost_share():
+    """THE consistent-hashing property: drop member 3 and every
+    tenant that 0/1/2 owned stays put — only 3's share moves."""
+    before = HashRing([0, 1, 2, 3])
+    after = HashRing([0, 1, 2])
+    tenants = [f"tenant-{i}" for i in range(1000)]
+    moved = 0
+    for t in tenants:
+        owner = before.route(t)
+        if owner == 3:
+            moved += 1
+            assert after.route(t) in (0, 1, 2)
+        else:
+            assert after.route(t) == owner, t
+    assert moved > 0  # member 3 did own something
+
+
+# -- the membership registry ------------------------------------------
+
+
+def test_announce_heartbeat_ttl_draining_retire(tmp_path):
+    fdir = str(tmp_path / "fleet")
+    me = FleetRegistry(
+        fdir, member_id=0, url="http://127.0.0.1:1234"
+    )
+    me.announce()
+    router = FleetRegistry(fdir)
+    assert [m.member_id for m in router.alive_members()] == [0]
+    assert router.ring().member_ids == (0,)
+    m = router.route("any-tenant")
+    assert m is not None and m.url == "http://127.0.0.1:1234"
+
+    # draining members announce but don't route
+    me.announce(draining=True)
+    assert router.alive_members() == []
+    assert len(router.all_members()) == 1
+    me.announce()  # back in
+
+    # a stale heartbeat ages the member out without any file deletion
+    stale = FleetRegistry(
+        fdir, member_id=1, url="http://127.0.0.1:9", ttl_s=0.05
+    )
+    stale.announce()
+    fast = FleetRegistry(fdir, ttl_s=0.05)
+    assert {m.member_id for m in fast.alive_members()} == {0, 1}
+    time.sleep(0.12)
+    assert fast.alive_members() == []  # both stale under tiny TTL
+
+    # retire deletes the file: gone from all_members, no quarantine
+    me.retire()
+    assert all(
+        m.member_id != 0 for m in router.all_members()
+    )
+    assert not chaos.is_quarantined(member_label(0))
+
+
+def test_torn_and_foreign_member_files_are_skipped(tmp_path):
+    fdir = str(tmp_path / "fleet")
+    FleetRegistry(
+        fdir, member_id=2, url="http://127.0.0.1:2"
+    ).announce()
+    with open(os.path.join(fdir, "member-099.json"), "w") as f:
+        f.write('{"member_id": 99, "url"')  # torn mid-write
+    with open(os.path.join(fdir, "member-098.json"), "w") as f:
+        json.dump({"schema": 999, "member_id": 98}, f)  # wrong schema
+    router = FleetRegistry(fdir)
+    assert [m.member_id for m in router.all_members()] == [2]
+
+
+def test_member_death_quarantines_and_reroutes(tmp_path):
+    fdir = str(tmp_path / "fleet")
+    for i in (0, 1):
+        FleetRegistry(
+            fdir, member_id=i, url=f"http://127.0.0.1:{7000 + i}"
+        ).announce()
+    router = FleetRegistry(fdir)
+    assert router.ring().member_ids == (0, 1)
+    ejected = router.note_member_death(1)
+    assert ejected == ()  # localhost fleet: no pod mesh to shrink
+    assert chaos.is_quarantined(member_label(1))
+    # routing drops the dead member IMMEDIATELY — no TTL wait
+    assert router.ring().member_ids == (0,)
+    assert [m.member_id for m in router.alive_members()] == [0]
+    snap = router.snapshot()
+    assert 1 in snap["quarantined_members"]
+    assert snap["ring_members"] == [0]
+
+
+# -- the in-process fleet ---------------------------------------------
+#
+# Two daemons in ONE process share the default dispatch plane
+# (own_plane=False — the plane seam exists exactly for this), their
+# own admission/tenant ledgers, and one store root; the front door
+# routes between them over real localhost HTTP.
+
+
+class _Fleet:
+    def __init__(self, tmp_path, n=2, mode="proxy", **daemon_kw):
+        self.fdir = str(tmp_path / "fleet")
+        root = str(tmp_path / "store")
+        self.daemons = []
+        self.threads = []
+        for i in range(n):
+            d = CheckerDaemon(
+                root=root, port=0, interpret=True,
+                fleet_dir=self.fdir, member_id=i,
+                own_plane=(i == 0), **daemon_kw,
+            )
+            t = threading.Thread(
+                target=d.serve_forever, daemon=True
+            )
+            t.start()
+            self.daemons.append(d)
+            self.threads.append(t)
+        self.door = FleetFrontDoor(self.fdir, port=0, mode=mode)
+        self.door_thread = threading.Thread(
+            target=self.door.serve_forever, daemon=True
+        )
+        self.door_thread.start()
+
+    def client(self, tenant, **kw):
+        kw.setdefault("retries", 0)
+        return CheckerClient(
+            port=self.door.port, tenant=tenant, **kw
+        )
+
+    def close(self):
+        self.door.shutdown()
+        self.door_thread.join(timeout=10)
+        self.door.close()
+        for d, t in zip(self.daemons, self.threads):
+            d.admission.start_drain()
+            d.httpd.shutdown()
+            t.join(timeout=10)
+            d.close()
+        dispatch.reset_default_plane()
+        chaos.reset_resilience()
+
+
+@pytest.fixture
+def fleet2(tmp_path):
+    fl = _Fleet(tmp_path, n=2)
+    try:
+        yield fl
+    finally:
+        fl.close()
+
+
+def _tenant_owned_by(ring, member_id, prefix="tenant"):
+    for i in range(10_000):
+        t = f"{prefix}-{i}"
+        if ring.route(t) == member_id:
+            return t
+    raise AssertionError(f"no tenant routes to member {member_id}")
+
+
+def test_proxy_parity_sticky_routing_and_stats_rollup(fleet2):
+    good = _register(401)
+    local = LinearizableChecker(interpret=True).check({}, good)
+    ring = fleet2.door.registry.ring()
+    assert ring.member_ids == (0, 1)
+    outs = {}
+    for mid in (0, 1):
+        tenant = _tenant_owned_by(ring, mid)
+        c = fleet2.client(tenant)
+        out = c.check(good, model="cas-register")
+        # served by the ring owner, verdict identical to a local run
+        assert out["fleet_member"] == mid
+        assert out["tenant"] == tenant
+        assert _fstrip(out) == _strip(local)
+        # sticky: the same tenant lands on the same member again
+        assert c.check(
+            good, model="cas-register"
+        )["fleet_member"] == mid
+        outs[mid] = out
+    st = fleet2.door.fleet_stats()
+    assert set(st["members"]) == {"0", "1"}
+    for mid in (0, 1):
+        assert st["members"][str(mid)]["completed"] == 2
+    assert st["rollup"]["completed"] == 4
+    assert st["door"]["routed"] >= 4
+    assert st["door"]["proxied"] >= 4
+    assert st["door"]["steals"] == 0
+    assert st["membership"]["ring_members"] == [0, 1]
+    # the door surfaces too
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{fleet2.door.port}/healthz", timeout=10
+    ) as r:
+        hz = json.loads(r.read())
+    assert hz["ok"] is True and hz["members_alive"] == 2
+
+
+def test_shedding_owner_gets_stolen_by_successor(fleet2):
+    """The owner's admission door sheds (draining admission — the
+    503 arm of SHED; a full queue's 429 rides the same branch): the
+    front door forwards the SAME bytes to the ring successor instead
+    of shedding the fleet, and counts the steal. The member-local
+    ledger stays authoritative — the door never overrode the shed,
+    it rerouted it."""
+    ring = fleet2.door.registry.ring()
+    tenant = _tenant_owned_by(ring, 0)
+    # drain member 0's ADMISSION only (not daemon.drain(), which
+    # would announce draining and leave the ring): alive, routable,
+    # shedding — the work-stealing shape
+    fleet2.daemons[0].admission.start_drain()
+    out = fleet2.client(tenant).check(
+        _register(402), model="cas-register"
+    )
+    assert out["fleet_member"] == 1  # stolen, not shed
+    assert out["valid?"] is True
+    st = fleet2.door.fleet_stats()
+    assert st["door"]["steals"] >= 1
+    assert st["door"]["exhausted"] == 0
+
+
+def test_all_members_shedding_relays_verdict_with_retry_after(
+    fleet2,
+):
+    for d in fleet2.daemons:
+        d.admission.start_drain()
+    with pytest.raises(ServiceError) as ei:
+        fleet2.client("anyone").check(
+            _register(403), model="cas-register"
+        )
+    assert ei.value.status == 503
+    assert ei.value.body.get("fleet_exhausted") is True
+    assert fleet2.door.fleet_stats()["door"]["exhausted"] >= 1
+
+
+def test_redirect_mode_client_follows_to_owner(tmp_path):
+    fl = _Fleet(tmp_path, n=2, mode="redirect")
+    try:
+        good = _register(404)
+        local = LinearizableChecker(interpret=True).check({}, good)
+        ring = fl.door.registry.ring()
+        tenant = _tenant_owned_by(ring, 1)
+        out = fl.client(tenant).check(good, model="cas-register")
+        # the client followed the 307 to the owner and got the real
+        # verdict (the owner itself doesn't stamp fleet_member)
+        assert _strip(out) == _strip(local)
+        assert out["tenant"] == tenant
+        st = fl.door.fleet_stats()
+        assert st["door"]["redirects"] >= 1
+        assert st["door"]["proxied"] == 0
+        # the member really served it
+        assert st["members"]["1"]["completed"] == 1
+    finally:
+        fl.close()
+
+
+def test_intent_journal_is_idempotent_and_recoverable(fleet2):
+    """A door dying between accept and relay loses nothing: the
+    journaled intent replays through recover_intents on the next
+    door, and retires once a member answers."""
+    door = fleet2.door
+    body = json.dumps({
+        "history": [
+            {"type": "invoke", "f": "write", "value": 1,
+             "process": 0, "index": 0},
+            {"type": "ok", "f": "write", "value": 1,
+             "process": 0, "index": 1},
+        ],
+        "model": "cas-register",
+    }).encode()
+    p1 = door.journal_intent("alice", "/check", body)
+    p2 = door.journal_intent("alice", "/check", body)
+    assert p1 == p2  # content-keyed: a retry overwrites, never piles
+    assert os.path.exists(p1)
+    replayed = door.recover_intents()
+    assert len(replayed) == 1
+    status, obj = replayed[0]
+    assert status == 200 and obj["valid?"] is True
+    assert not os.path.exists(p1)  # retired after a member answered
+    assert door.fleet_stats()["door"]["intents_recovered"] == 1
+
+
+def test_dead_member_hand_off_on_the_wire(tmp_path):
+    """A member that dies between announce and serve: the door eats
+    the connection error, quarantines the member fleet-wide, and the
+    SAME bytes run on the survivor — the client sees one verdict and
+    zero errors."""
+    fl = _Fleet(tmp_path, n=2)
+    try:
+        ring = fl.door.registry.ring()
+        victim = 0
+        tenant = _tenant_owned_by(ring, victim)
+        # kill the victim's socket but leave its (now stale) announce
+        # file in place: dead on the wire, not retired
+        fl.daemons[victim]._registry.stop_heartbeat()
+        fl.daemons[victim].httpd.shutdown()
+        fl.threads[victim].join(timeout=10)
+        fl.daemons[victim].httpd.server_close()
+        out = fl.client(tenant).check(
+            _register(405), model="cas-register"
+        )
+        assert out["fleet_member"] == 1
+        assert out["valid?"] is True
+        st = fl.door.fleet_stats()
+        assert st["door"]["member_deaths"] >= 1
+        assert st["door"]["handoffs"] >= 1
+        assert chaos.is_quarantined(member_label(victim))
+        # dead member is out of the ring for every later request
+        assert fl.door.registry.ring().member_ids == (1,)
+    finally:
+        fl.close()
+
+
+# -- zero-loss hand-off via content-hash identity ---------------------
+
+
+def test_same_bytes_same_check_id_same_checkpoint_path(tmp_path):
+    body = json.dumps({"history": [1, 2, 3]}).encode()
+    cid = check_id_for("cas-register", body)
+    assert cid == check_id_for("cas-register", body)
+    assert cid != check_id_for("cas-register", body + b" ")
+    assert cid != check_id_for("bank", body)
+    s1 = Store(str(tmp_path / "shared"))
+    s2 = Store(str(tmp_path / "shared"))
+    # two members over one store root derive ONE checkpoint home
+    assert (
+        s1.service_checkpoint_path("alice", cid)
+        == s2.service_checkpoint_path("alice", cid)
+    )
+    assert (
+        s1.service_checkpoint_path("bob", cid)
+        != s1.service_checkpoint_path("alice", cid)
+    )
+
+
+def test_two_sink_hand_off_resumes_across_members(
+    tmp_path, small_w
+):
+    """THE hand-off regression (PR 18 satellite): member A dies
+    mid-check at a durable boundary; member B opens a sink on the
+    same path (same bytes → same check id → same checkpoint home)
+    and RESUMES — strictly fewer launches than a cold run, identical
+    verdict, and the takeover is recorded (resumed_from_owner, the
+    handoffs counter, the new owner in the summary)."""
+    from test_checkpoint import Die, _die_after, _run
+
+    h = burst_history(nburst=5)
+    steps = _steps(h)
+    segs = bs.plan_segments(steps, min_len=1)
+    assert len(segs) >= 3
+
+    # the shared store root both members mount
+    store = Store(str(tmp_path / "shared"))
+    body = json.dumps({"history": "same-bytes"}).encode()
+    cid = check_id_for("cas-register", body)
+    path = store.service_checkpoint_path("alice", cid)
+
+    reset_checkpoint_stats()
+    # member A runs the check, SIGKILLed after 2 durable segments
+    sink_a = CheckpointSink(
+        path, seg_min_len=1, owner="member-0",
+        after_save=_die_after(2),
+    )
+    with pytest.raises(Die):
+        _run(steps, sink_a)
+
+    # member B inherits the same bytes (the door re-forwarded them)
+    bs.reset_launch_stats()
+    sink_b = CheckpointSink(path, seg_min_len=1, owner="member-1")
+    v = _run(_steps(h), sink_b)
+    assert sink_b.resumed_from == 2  # A's frontier, not a restart
+    assert sink_b.resumed_from_owner == "member-0"
+    assert bs.LAUNCH_STATS["launches"] == len(segs) - 2
+    st = checkpoint_stats()
+    assert st["handoffs"] == 1
+    assert st["resumes"] == 1
+
+    # verdict parity vs an uninterrupted solo run
+    cold = _run(
+        _steps(h),
+        CheckpointSink(str(tmp_path / "cold"), seg_min_len=1),
+    )
+    assert v == cold
+
+    # the takeover is visible in the durable summary
+    summary = sink_b.summary()
+    assert summary["owner"] == "member-1"
+    assert summary["resumed_from_owner"] == "member-0"
+
+
+def test_same_owner_resume_is_not_a_handoff(tmp_path, small_w):
+    """A member resuming its OWN crash is a resume, never a
+    hand-off — the counter only moves when ownership changes."""
+    from test_checkpoint import Die, _die_after, _run
+
+    h = burst_history(nburst=5)
+    reset_checkpoint_stats()
+    sink = CheckpointSink(
+        str(tmp_path), seg_min_len=1, owner="member-0",
+        after_save=_die_after(2),
+    )
+    with pytest.raises(Die):
+        _run(_steps(h), sink)
+    sink2 = CheckpointSink(
+        str(tmp_path), seg_min_len=1, owner="member-0"
+    )
+    _run(_steps(h), sink2)
+    assert sink2.resumed_from == 2
+    assert sink2.resumed_from_owner is None
+    assert checkpoint_stats()["handoffs"] == 0

@@ -296,6 +296,36 @@ def snapshot():
         return dict(CORPUS_STATS)
 """,
     ),
+    "JT206": (
+        # routing state edited outside the membership lock: a
+        # concurrent router reads a half-updated member set
+        """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._membership_lock = threading.Lock()
+        self._members = {}
+        self._ring = None
+
+    def note_join(self, mid, url):
+        self._members[mid] = url
+""",
+        """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._membership_lock = threading.Lock()
+        self._members = {}
+        self._ring = None
+
+    def note_join(self, mid, url):
+        with self._membership_lock:
+            self._members[mid] = url
+            self._ring = None
+""",
+    ),
     "JT301": (
         # span held in a variable — never (reliably) closed
         """
@@ -615,7 +645,7 @@ def test_rule_catalog_partitions_by_family():
     all_rules = list(analysis.META_RULES) + family_rules
     assert len(all_rules) == len(set(all_rules))
     assert set(all_rules) == set(analysis.RULES)
-    assert analysis.rules_total() == len(analysis.RULES) == 25
+    assert analysis.rules_total() == len(analysis.RULES) == 26
 
 
 def test_host_get_funnel_itself_is_exempt():
@@ -975,7 +1005,7 @@ def test_cli_json_contract():
     assert rec["clean"] is True
     assert rec["findings"] == []
     # per-rule descriptions and the catalog size ride the report
-    assert rec["rules_total"] == analysis.rules_total() == 25
+    assert rec["rules_total"] == analysis.rules_total() == 26
     assert set(rec["rules"]) == set(analysis.RULES)
     for meta in rec["rules"].values():
         assert meta["title"] and meta["invariant"]

@@ -1,0 +1,156 @@
+#!/usr/bin/env bash
+# Budget-capped 2-member localhost fleet smoke, CPU CI-runnable.
+#
+# The PR 18 zero-loss drill, end to end through the real `cli fleet`
+# entry point (no test harness seams):
+#
+#   1. start a 2-member fleet behind a proxy front door
+#   2. accept one durable check per member (tenants chosen so BOTH
+#      members own work) — verdicts land, checkpoints persist under
+#      the shared store root
+#   3. SIGKILL member 0 (no drain, no retire: its announce file and
+#      its durable checkpoints stay behind)
+#   4. replay the dead member's bytes through the door: the door
+#      declares the death (quarantine ladder), the survivor inherits
+#      the tenant, and content-hash identity serves the verdict from
+#      the dead member's OWN durable record — zero accepted checks
+#      lost; fresh work for that tenant also lands on the survivor
+#   5. SIGTERM the fleet: clean drain, exit 0
+#
+# Usage: tools/fleet-smoke.sh [budget-seconds]   (default: 600)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+BUDGET="${1:-600}"
+WORK="$(mktemp -d -t jepsen-tpu-fleet-smoke-XXXXXX)"
+FLEET_PID=""
+cleanup() {
+  if [ -n "$FLEET_PID" ]; then kill -9 "$FLEET_PID" 2>/dev/null || true; fi
+  pkill -9 -f "jepsen_tpu.cli daemon.*$WORK" 2>/dev/null || true
+  rm -rf "$WORK"
+}
+trap cleanup EXIT
+
+export JAX_PLATFORMS=cpu
+export JEPSEN_TPU_INTERPRET=1
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$WORK/jax_cache}"
+
+echo "fleet-smoke: starting 2-member fleet (budget ${BUDGET}s)"
+python -m jepsen_tpu.cli fleet --members 2 --store "$WORK/store" \
+  --fleet-dir "$WORK/fleet" --port 0 --member-devices 2 \
+  --spawn-timeout "$BUDGET" >"$WORK/fleet.log" 2>&1 &
+FLEET_PID=$!
+
+python - "$WORK" "$BUDGET" <<'EOF'
+import json
+import os
+import random
+import re
+import signal
+import sys
+import time
+import urllib.request
+
+work, budget = sys.argv[1], float(sys.argv[2])
+log_path = os.path.join(work, "fleet.log")
+
+# the door prints its bound URL once the whole fleet is alive
+url = None
+deadline = time.time() + budget
+while time.time() < deadline:
+    if os.path.exists(log_path):
+        m = re.search(
+            r"front door \(proxy\) on (http://[0-9.]+:[0-9]+)",
+            open(log_path).read(),
+        )
+        if m:
+            url = m.group(1)
+            break
+    time.sleep(0.5)
+assert url, "front door never came up:\n" + (
+    open(log_path).read() if os.path.exists(log_path) else "<no log>"
+)
+port = int(url.rsplit(":", 1)[1])
+print(f"fleet-smoke: door on {url}")
+
+sys.path.insert(0, ".")
+from jepsen_tpu.service.client import CheckerClient  # noqa: E402
+from jepsen_tpu.service.membership import FleetRegistry  # noqa: E402
+from jepsen_tpu.sim import gen_register_history  # noqa: E402
+
+fdir = os.path.join(work, "fleet")
+ring = FleetRegistry(fdir).ring()
+assert ring.member_ids == (0, 1), ring.member_ids
+
+
+def owned_by(mid):
+    i = 0
+    while True:
+        t = f"smoke-{i}"
+        if ring.route(t) == mid:
+            return t
+        i += 1
+
+
+tenants = {m: owned_by(m) for m in (0, 1)}
+hists = {
+    m: gen_register_history(
+        random.Random(50 + m), n_ops=80, n_procs=4, p_crash=0.0
+    )
+    for m in (0, 1)
+}
+
+# phase 2: both members accept + durably complete one check
+for m, t in tenants.items():
+    c = CheckerClient(port=port, tenant=t, timeout_s=300, retries=4)
+    out = c.check(hists[m], model="cas-register", durable=True)
+    assert out.get("fleet_member") == m, out
+    assert "valid?" in out, out
+print("fleet-smoke: both members serving (durable checks landed)")
+
+# phase 3: SIGKILL member 0 — no drain, no retire
+victim = json.load(open(os.path.join(fdir, "member-000.json")))
+os.kill(victim["pid"], signal.SIGKILL)
+print(f"fleet-smoke: SIGKILLed member 0 (pid {victim['pid']})")
+
+# phase 4: the dead member's tenant replays the SAME bytes — the
+# door declares the death and the survivor answers from the dead
+# member's durable record (same bytes -> same check id -> same
+# checkpoint under the shared store root). Nothing accepted is lost.
+c = CheckerClient(
+    port=port, tenant=tenants[0], timeout_s=300, retries=6,
+    backoff_s=0.5,
+)
+out = c.check(hists[0], model="cas-register", durable=True)
+assert out.get("fleet_member") == 1, out
+assert "valid?" in out, out
+# fresh work for the orphaned tenant keeps flowing too
+out2 = c.check(
+    gen_register_history(
+        random.Random(99), n_ops=80, n_procs=4, p_crash=0.0
+    ),
+    model="cas-register", durable=True,
+)
+assert out2.get("fleet_member") == 1, out2
+
+st = json.loads(
+    urllib.request.urlopen(f"{url}/stats", timeout=30).read()
+)
+assert st["door"]["member_deaths"] >= 1, st["door"]
+assert st["membership"]["ring_members"] == [1], st["membership"]
+print("fleet-smoke: zero-loss hand-off OK "
+      + json.dumps(st["door"]))
+EOF
+
+# phase 5: SIGTERM drains the fleet cleanly (the SIGKILLed member is
+# already gone; the survivor drains + retires, then the door stops)
+kill -TERM "$FLEET_PID"
+RC=0
+wait "$FLEET_PID" || RC=$?
+FLEET_PID=""
+grep -q "fleet drained" "$WORK/fleet.log" || {
+  echo "fleet-smoke: FAIL: no clean drain"; tail -20 "$WORK/fleet.log"
+  exit 1
+}
+[ "$RC" -eq 0 ] || { echo "fleet-smoke: FAIL: fleet rc=$RC"; exit 1; }
+echo "fleet-smoke: OK (accept -> SIGKILL -> zero-loss drain)"
