@@ -1474,6 +1474,123 @@ def bench_fleet(n_members: int) -> None:
         print(f"trend ledger: appended to {path}", file=sys.stderr)
 
 
+# -- fleet chaos drill (--fleet-chaos) ---------------------------------------
+
+
+def bench_fleet_chaos() -> None:
+    """The continuously-verified chaos drill as a bench gate
+    (--fleet-chaos): a live subprocess fleet under the seeded fault
+    gauntlet — member SIGKILL, a SIGSTOP gray period, torn registry
+    writes, heartbeat clock skew, checkpoint corruption — with real
+    multi-tenant traffic flowing the whole time.
+
+    Unlike --fleet (a throughput ratio), this row's value is the
+    invariant monitor's verdict (service/invariants.py), and the gate
+    is CORRECTNESS UNDER FIRE, always hard (SystemExit 8, matching
+    `cli fleet-drill`'s exit code):
+
+    - zero accepted-check loss: every check the door accepted got a
+      verdict (after the settle sweep), and no durable intent was
+      orphaned;
+    - at-most-once verdict side-effects: no check_id ever produced
+      divergent verdicts across members/retries/hand-offs;
+    - verdict parity: every fleet verdict matches a solo in-process
+      oracle re-check of the same history;
+    - gray eviction: the SIGSTOPped member left the routable set
+      within 2x the door's health window;
+    - restoration: the supervisor brought members_alive back to
+      target within its restart budget.
+
+    Emits one JSON line (metric fleet_chaos) with the full invariant
+    report embedded, and appends a trend row (fleet_size stamped so
+    the row segregates from solo trajectories). Smoke mode shrinks
+    the drill (fewer faults, shorter windows) but the gate stays
+    hard — a lost check in a 20-second drill is as disqualifying as
+    in a 5-minute one."""
+    import os
+    import tempfile
+
+    import jax
+
+    from jepsen_tpu.service.nemesis import run_fleet_drill
+
+    seed = int(os.environ.get("JEPSEN_TPU_DRILL_SEED", "0"))
+    duration = 20.0 if SMOKE else 60.0
+    gray_s = 8.0 if SMOKE else 14.0
+    classes = (
+        ("kill", "stall", "torn_write") if SMOKE else None
+    )
+    root = tempfile.mkdtemp(prefix="bench-fleet-chaos-")
+    fleet_dir = os.path.join(root, ".fleet")
+    t0 = time.perf_counter()
+    report = run_fleet_drill(
+        root, fleet_dir,
+        members=2,
+        duration_s=duration,
+        seed=seed,
+        gray_s=gray_s,
+        member_devices=2,
+        classes=classes,
+        log_dir=fleet_dir,
+    )
+    wall = time.perf_counter() - t0
+
+    record = {
+        "metric": "fleet_chaos",
+        # the trend value: unique checks that survived the gauntlet
+        # per second of drill (0 when the gate fails — the trajectory
+        # makes a broken drill visible, not just the exit code)
+        "value": round(
+            report["checks"]["unique"] / duration, 3
+        ) if report.get("clean") else 0.0,
+        "unit": "verified checks/s under fault gauntlet",
+        "backend": jax.default_backend(),
+        "fleet_size": 2,
+        "seed": seed,
+        "duration_s": duration,
+        "wall_s": round(wall, 3),
+        "clean": bool(report.get("clean")),
+        "violations": report.get("violations"),
+        "checks": report.get("checks"),
+        "parity": report.get("parity"),
+        "faults_fired": [
+            f for f in report.get("faults", [])
+        ],
+        "supervisor": report.get("supervisor"),
+        "health": report.get("health"),
+        "door": report.get("door"),
+        "vs_baseline": None,
+        "smoke": SMOKE,
+    }
+    print(json.dumps(record, default=str))
+
+    if not report.get("clean"):
+        kinds = sorted(
+            {v["invariant"] for v in report["violations"]}
+        )
+        print(
+            f"FLEET CHAOS GATE: {len(report['violations'])} "
+            f"invariant violation(s) under the fault gauntlet "
+            f"({', '.join(kinds)}) — "
+            f"{json.dumps(report['violations'], default=str)}",
+            file=sys.stderr,
+        )
+        raise SystemExit(8)
+    print(
+        f"fleet chaos drill clean: {report['checks']['unique']} "
+        f"unique checks, {len(report.get('faults', []))} faults "
+        f"fired, {report['checks']['lost']} lost, parity "
+        f"{(report.get('parity') or {}).get('compared', 0)} compared "
+        f"/ {(report.get('parity') or {}).get('mismatches', [])} "
+        "mismatches",
+        file=sys.stderr,
+    )
+
+    if "--no-trend" not in sys.argv:
+        path = append_trend_row(trend_row_from_record(record))
+        print(f"trend ledger: appended to {path}", file=sys.stderr)
+
+
 # -- reduction configs (3, 4, 5) ---------------------------------------------
 
 
@@ -2139,10 +2256,10 @@ def main() -> None:
         # all five families (incl. D lockorder / E determinism) must
         # be active before the number is publishable.
         _rules_total = analysis.rules_total()
-        if _rules_total < 26:
+        if _rules_total < 27:
             raise SystemExit(
                 f"bench: planelint catalog shrank to {_rules_total} "
-                "rules (< 26): a family is disabled; refusing to "
+                "rules (< 27): a family is disabled; refusing to "
                 "publish"
             )
         print(
@@ -2231,6 +2348,10 @@ def main() -> None:
 
     if "--streams-1k" in sys.argv:
         bench_streams_1k()
+        return
+
+    if "--fleet-chaos" in sys.argv:
+        bench_fleet_chaos()
         return
 
     _fleet = _argval("--fleet")

@@ -31,6 +31,14 @@ Rules:
   admission ledgers and stream state then split across members.
   ``__init__`` bodies are exempt (single-threaded construction), and
   locals are out of scope: only attribute state can be shared.
+- JT207 process control — a signal send (``os.kill``,
+  ``proc.terminate()``/``.send_signal()``) or subprocess spawn
+  (``subprocess.Popen``/``run``, ``spawn_*`` helpers) — while holding
+  a lock. A fork pays page-table copy + exec latency and a signal
+  delivery can block on an uninterruptible target; either one stalls
+  every router/supervisor thread contending for the registry or plane
+  lock it rides. The sanctioned shape is the supervisor's: decide
+  WHICH members to respawn under the lock, release it, then spawn.
 """
 
 from __future__ import annotations
@@ -79,6 +87,17 @@ _AGG_METHODS = {"items", "values", "keys", "copy"}
 _MEMBERSHIP_RE = re.compile(
     r"^_?(members|ring|routing|route_table)$"
 )
+
+#: JT207 process control under a held lock: signal-send spellings
+#: (dotted module calls and process-handle methods) and spawn
+#: spellings. ``.wait()``/``.join()`` are JT202's beat, not ours.
+_SIGNAL_DOTTED = {"os.kill", "os.killpg"}
+_SIGNAL_METHODS = {"terminate", "send_signal"}
+_SPAWN_DOTTED = {
+    "subprocess.Popen", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output", "Popen",
+}
+_SPAWN_NAME_RE = re.compile(r"^spawn_")
 
 
 def _is_membership_attr(node: ast.expr) -> bool:
@@ -370,6 +389,27 @@ class ConcurrencyChecker(ast.NodeVisitor):
                     f"{held} — a hook that re-enters the stats API "
                     "deadlocks; snapshot under the lock, call hooks "
                     "after release",
+                )
+            # JT207: process control (signal send / subprocess
+            # spawn) under a held lock
+            proc_ctl = None
+            if fd in _SIGNAL_DOTTED:
+                proc_ctl = f"signal send {fd}()"
+            elif isinstance(node.func, ast.Attribute) and (
+                node.func.attr in _SIGNAL_METHODS
+            ):
+                proc_ctl = f"signal send .{node.func.attr}()"
+            elif fd in _SPAWN_DOTTED:
+                proc_ctl = f"subprocess spawn {fd}()"
+            elif seg and _SPAWN_NAME_RE.match(seg):
+                proc_ctl = f"subprocess spawn {seg}()"
+            if proc_ctl:
+                self.add(
+                    "JT207", node,
+                    f"{proc_ctl} while holding {held} — a fork/exec "
+                    "or signal delivery stalls every thread "
+                    "contending for the lock; decide under the lock, "
+                    "release it, then spawn/signal",
                 )
 
         # JT203: thread creation without a bounded-join seam

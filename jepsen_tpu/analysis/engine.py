@@ -73,6 +73,7 @@ FAMILY_B_FILES = (
     "service/*.py",
     "pod/topology.py",
     "pod/faultdomains.py",
+    "pod/launcher.py",
     "cli.py",
 )
 
@@ -182,6 +183,12 @@ RULES: Dict[str, Tuple[str, str]] = {
         "rings, route tables) mutates only under the membership "
         "lock — routers must never read a half-updated ring",
     ),
+    "JT207": (
+        "process control under a held lock",
+        "signal sends (os.kill, Process.terminate) and subprocess "
+        "spawns happen outside registry/ring/plane locks — decide "
+        "under the lock, release it, then fork/signal",
+    ),
     "JT301": (
         "span not context-managed",
         "span(...) is always entered via with — a held span "
@@ -253,7 +260,8 @@ META_RULES: Tuple[str, ...] = ("JT000", "JT001")
 FAMILY_RULES: Dict[str, Tuple[str, ...]] = {
     "A": ("JT101", "JT102", "JT103", "JT104", "JT105", "JT106",
           "JT107"),
-    "B": ("JT201", "JT202", "JT203", "JT204", "JT205", "JT206"),
+    "B": ("JT201", "JT202", "JT203", "JT204", "JT205", "JT206",
+          "JT207"),
     "C": ("JT301", "JT302", "JT303", "JT304", "JT305"),
     "D": ("JT401", "JT402", "JT403"),
     "E": ("JT501", "JT502", "JT503"),

@@ -571,6 +571,27 @@ def quarantine_label(label: str) -> bool:
     return tripped
 
 
+def clear_quarantine_label(label: str) -> bool:
+    """Re-admit one label: drop its quarantine row and reset its
+    failure count. The supervision path — a respawned fleet member
+    carries the same ``host:<i>`` label its dead predecessor was
+    ejected under, and without re-admission the replacement would be
+    born quarantined (routers skip it forever). Scoped to ONE label on
+    purpose: fleet re-admission must never amnesty other breakers the
+    way ``reset_resilience`` does. Returns True when a row was
+    actually cleared."""
+    with _stats_lock:
+        cleared = label in _QUARANTINED
+        if cleared:
+            _QUARANTINED.remove(label)
+        _DEVICE_FAILURES.pop(label, None)
+    if cleared:
+        obs_trace.instant(
+            "quarantine_cleared", kind="chaos", device=label
+        )
+    return cleared
+
+
 def quarantined_devices() -> tuple:
     """Real quarantined device labels (tenant and host pseudo-labels
     excluded — per-chip matching paths only ever name chips; host rows

@@ -31,6 +31,11 @@ EXIT_HOSTILE_HISTORY = 3
 #: `lint` found non-baselined planelint findings (distinct from every
 #: verdict code so CI can tell "dirty tree" from "invalid history")
 EXIT_LINT_DIRTY = 5
+#: `fleet-drill` / `bench --fleet-chaos` invariant gate failed: the
+#: chaos gauntlet ran, but the invariant monitor found a violation
+#: (lost accepted check, divergent verdicts, gray member never
+#: evicted, fleet not restored within budget)
+EXIT_DRILL = 8
 EXIT_CRASH = 254
 EXIT_USAGE = 255
 
@@ -828,6 +833,7 @@ def cmd_daemon(args) -> int:
         audit_max_bytes=args.audit_max_mb << 20,
         fleet_dir=args.fleet_dir,
         member_id=args.member_id,
+        member_epoch=args.member_epoch,
     )
     handle = install_signal_drain(daemon.drain)
     member = (
@@ -924,6 +930,56 @@ def cmd_fleet(args) -> int:
         door.close()
     print("fleet drained. (code 0)")
     return EXIT_VALID
+
+
+def cmd_fleet_drill(args) -> int:
+    """Run the fleet chaos gauntlet (service/nemesis.run_fleet_drill):
+    spawn a real subprocess fleet, inject the seeded fault schedule
+    (SIGKILL, SIGSTOP gray periods, torn registry writes, clock skew,
+    checkpoint corruption) while live multi-tenant traffic flows, and
+    gate on the invariant monitor: zero accepted-check loss,
+    at-most-once verdicts per check_id, verdict parity against a solo
+    oracle, gray-member eviction within budget, and supervised fleet
+    restoration. Exit 8 on any violation."""
+    import json
+    import os
+
+    from jepsen_tpu.service.nemesis import run_fleet_drill
+
+    fleet_dir = args.fleet_dir or os.path.join(
+        args.store, ".fleet-drill"
+    )
+    classes = (
+        [c.strip() for c in args.classes.split(",") if c.strip()]
+        if args.classes else None
+    )
+    report = run_fleet_drill(
+        args.store, fleet_dir,
+        members=args.members,
+        duration_s=args.duration,
+        seed=args.seed,
+        gray_s=args.gray_seconds,
+        restart_budget=args.restart_budget,
+        member_devices=args.member_devices,
+        spawn_timeout_s=args.spawn_timeout,
+        classes=classes,
+        log_dir=fleet_dir,
+        parity=not args.no_parity,
+    )
+    out = json.dumps(report, indent=2, sort_keys=True, default=str)
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(out + "\n")
+    print(out)
+    if report.get("clean"):
+        print(f"fleet drill clean: {report['checks']['unique']} "
+              f"unique checks under fire, 0 lost. (code 0)")
+        return EXIT_VALID
+    kinds = sorted({v["invariant"] for v in report["violations"]})
+    print(f"fleet drill FAILED: {len(report['violations'])} "
+          f"violation(s) ({', '.join(kinds)}). (code {EXIT_DRILL})",
+          file=sys.stderr)
+    return EXIT_DRILL
 
 
 def _epitaph(code: int) -> str:
@@ -1153,6 +1209,11 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--member-id", type=int, default=None,
                    help="this daemon's fleet member id (with "
                         "--fleet-dir; default 0)")
+    d.add_argument("--member-epoch", type=int, default=None,
+                   help="this member's supervision epoch (set by the "
+                        "fleet supervisor on respawn; an older "
+                        "incarnation of the same member id fences "
+                        "itself instead of double-owning checks)")
     d.set_defaults(fn=cmd_daemon)
 
     fl = sub.add_parser(
@@ -1191,6 +1252,45 @@ def build_parser() -> argparse.ArgumentParser:
                     help="budget for all members to come alive "
                          "(first launch pays JAX import + compile)")
     fl.set_defaults(fn=cmd_fleet)
+
+    fd = sub.add_parser(
+        "fleet-drill",
+        help="continuously-verified chaos drill: a live fleet under "
+             "the seeded fault gauntlet, gated on the invariant "
+             "monitor (exit 8 on violation)",
+    )
+    shared(fd)
+    fd.add_argument("--members", type=int, default=2,
+                    help="fleet size under drill (min 2; default 2)")
+    fd.add_argument("--duration", type=float, default=30.0,
+                    metavar="S",
+                    help="traffic-under-fire window (default 30s; "
+                         "settle/restore time is extra)")
+    fd.add_argument("--seed", type=int, default=0,
+                    help="fault-schedule seed (same seed = same "
+                         "drill, byte for byte)")
+    fd.add_argument("--classes", default=None, metavar="K1,K2,...",
+                    help="restrict the gauntlet to these fault "
+                         "classes (kill,stall,delay,drop,torn_write,"
+                         "clock_skew,checkpoint_corrupt); default all")
+    fd.add_argument("--gray-seconds", type=float, default=12.0,
+                    metavar="S",
+                    help="SIGSTOP gray-failure period length")
+    fd.add_argument("--restart-budget", type=int, default=3,
+                    help="supervisor respawns per member")
+    fd.add_argument("--member-devices", type=int, default=2,
+                    help="virtual CPU devices per member (default 2)")
+    fd.add_argument("--fleet-dir", default=None, metavar="DIR",
+                    help="registry dir (default <store>/.fleet-drill)")
+    fd.add_argument("--spawn-timeout", type=float, default=180.0,
+                    metavar="S",
+                    help="budget for the initial fleet to come alive")
+    fd.add_argument("--report", default=None, metavar="PATH",
+                    help="also write the invariant report JSON here")
+    fd.add_argument("--no-parity", action="store_true",
+                    help="skip the solo-oracle verdict-parity pass "
+                         "(faster; weakens the gate)")
+    fd.set_defaults(fn=cmd_fleet_drill)
     return p
 
 

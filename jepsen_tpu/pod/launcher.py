@@ -137,6 +137,7 @@ def spawn_fleet_member(
     *,
     n_local_devices: int = 4,
     interpret: bool = True,
+    epoch: int = 0,
     python: Optional[str] = None,
     extra_args: Optional[List[str]] = None,
     extra_env: Optional[Dict[str, str]] = None,
@@ -148,7 +149,12 @@ def spawn_fleet_member(
     discovers it through the registry rather than picking ports —
     poll ``wait_fleet`` for readiness. The caller owns the process
     (terminate/kill/wait); SIGKILL-ing one is the fleet durability
-    drill, and the front door declares the death on first contact."""
+    drill, and the front door declares the death on first contact.
+
+    ``epoch`` is the supervision fence (service/supervisor.py): a
+    respawned member announces ``epoch = prior + 1`` so any
+    resurrected earlier incarnation fences itself instead of
+    double-owning handed-off checks."""
     env = member_env(n_local_devices)
     if interpret:
         env["JEPSEN_TPU_INTERPRET"] = "1"
@@ -159,6 +165,8 @@ def spawn_fleet_member(
         "--store", root, "--port", "0",
         "--fleet-dir", fleet_dir, "--member-id", str(member_id),
     ]
+    if epoch:
+        cmd += ["--member-epoch", str(int(epoch))]
     cmd += list(extra_args or [])
     logf = open(log_path, "ab") if log_path else subprocess.DEVNULL
     try:

@@ -37,11 +37,29 @@ robustness surface is the package's point:
 
 ``client.py`` is the stdlib client library; bench.py routes through it
 to measure the warm-plane-vs-cold-process delta.
+
+The fleet tier turns the nemesis on the service itself: a seeded
+fault schedule against live members (``nemesis.py``), restart-
+budgeted self-healing with epoch fencing (``supervisor.py``), and a
+continuously-verified invariant gate over the whole exercise
+(``invariants.py``) — ``run_fleet_drill`` is the `cli fleet-drill` /
+`bench --fleet-chaos` entry point.
 """
 
 from jepsen_tpu.service.admission import AdmissionControl, AdmissionError
 from jepsen_tpu.service.client import CheckerClient, ServiceError
+from jepsen_tpu.service.invariants import InvariantMonitor
+from jepsen_tpu.service.nemesis import (
+    FleetChaosPlan,
+    FleetFault,
+    FleetNemesis,
+    run_fleet_drill,
+)
 from jepsen_tpu.service.server import CheckerDaemon
+from jepsen_tpu.service.supervisor import (
+    FleetSupervisor,
+    SupervisionPolicy,
+)
 from jepsen_tpu.service.tenants import TenantLedger
 
 __all__ = [
@@ -49,6 +67,13 @@ __all__ = [
     "AdmissionError",
     "CheckerClient",
     "CheckerDaemon",
+    "FleetChaosPlan",
+    "FleetFault",
+    "FleetNemesis",
+    "FleetSupervisor",
+    "InvariantMonitor",
     "ServiceError",
+    "SupervisionPolicy",
     "TenantLedger",
+    "run_fleet_drill",
 ]

@@ -47,6 +47,11 @@ REDIRECT = frozenset({307, 308})
 #: redirect-chain bound — a routing loop fails fast, not forever
 MAX_REDIRECTS = 4
 
+#: how many times a stream replays itself from op 0 after losing its
+#: sticky owner before giving up (each replay needs the fleet to hold
+#: still long enough for every chunk to land on ONE member)
+MAX_STREAM_REPLAYS = 3
+
 
 class ServiceError(Exception):
     """A non-200 daemon response: carries the HTTP ``status``, the
@@ -159,7 +164,9 @@ class CheckerClient:
                     )
                     hops += 1
                     continue
-            if status == 200:
+            if 200 <= status < 300:
+                # 200 = verdict; 202 = a stream chunk's provisional
+                # status — both are answers, not refusals
                 return obj
             if status in RETRYABLE and attempt < self.retries:
                 ra = self._retry_after(headers)
@@ -209,8 +216,186 @@ class CheckerClient:
         body = json.dumps(req).encode()
         return self._roundtrip("POST", "/check", body)
 
+    def stream(
+        self,
+        stream_id: str,
+        model: Optional[str] = None,
+        init_value: Any = None,
+        durable: bool = False,
+        persist_every: Optional[int] = None,
+        gc_window: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> "ClientStream":
+        """Open a client-side streaming check. The returned
+        ``ClientStream`` survives the sticky owner dying mid-stream:
+        it re-resolves ownership through the front door and replays
+        the stream from op 0 on the new owner (durable streams resume
+        launch-free from their persisted frontier)."""
+        return ClientStream(
+            self, stream_id, model=model, init_value=init_value,
+            durable=durable, persist_every=persist_every,
+            gc_window=gc_window, deadline_s=deadline_s,
+        )
+
     def stats(self) -> dict:
         return self._roundtrip("GET", "/stats")
 
     def health(self) -> dict:
         return self._roundtrip("GET", "/healthz")
+
+
+class ClientStream:
+    """One streaming check, fleet-failover-aware.
+
+    Before this class, stream stickiness broke PERMANENTLY when the
+    sticky member died mid-stream: the front door fails the next
+    chunk over to the ring successor, which has never seen the
+    stream — a mid-stream chunk lands COLD there and either errors or
+    (worse) silently judges a history missing its prefix. The client
+    is the only party holding the full op sequence, so recovery lives
+    here: every appended chunk is buffered, and when an append's
+    answer comes back from a DIFFERENT member than the sticky owner
+    (or the append fails with a member-death-shaped error), the
+    stream replays itself from op 0 at the new owner with
+    ``restart=true`` on the first chunk (dropping any poisoned
+    partial state server-side). A durable stream's replayed prefix
+    hashes identically, so the new owner resumes from the persisted
+    frontier instead of re-launching — the solo daemon-restart resume
+    protocol, now riding fleet fail-over automatically."""
+
+    def __init__(
+        self,
+        client: CheckerClient,
+        stream_id: str,
+        model: Optional[str] = None,
+        init_value: Any = None,
+        durable: bool = False,
+        persist_every: Optional[int] = None,
+        gc_window: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ):
+        self.client = client
+        self.stream_id = str(stream_id)
+        self.model = model
+        self.init_value = init_value
+        self.durable = bool(durable)
+        self.persist_every = persist_every
+        self.gc_window = gc_window
+        self.deadline_s = deadline_s
+        #: wire-encoded chunks appended so far — the replay buffer
+        self._sent: list = []
+        #: the sticky member id (None until the first fleet answer,
+        #: and always None against a solo daemon)
+        self._member: Optional[int] = None
+        #: replays performed (surfaced for tests/observability)
+        self.replays = 0
+        self._done = False
+
+    def _payload(
+        self, ops: list, final: bool, restart: bool = False
+    ) -> bytes:
+        req: dict = {
+            "stream_id": self.stream_id, "ops": ops, "final": final,
+        }
+        if self.model is not None:
+            req["model"] = self.model
+        if self.init_value is not None:
+            req["init_value"] = self.init_value
+        if self.durable:
+            req["durable"] = True
+        if self.persist_every is not None:
+            req["persist_every"] = self.persist_every
+        if self.gc_window is not None:
+            req["gc_window"] = self.gc_window
+        if self.deadline_s is not None:
+            req["deadline_s"] = self.deadline_s
+        if restart:
+            req["restart"] = True
+        return json.dumps(req).encode()
+
+    def append(self, chunk, final: bool = False) -> dict:
+        """Append one chunk (History | list[Op] | list[dict]);
+        returns the provisional status (non-final) or the definite
+        verdict (final). Transparently replays through the door when
+        the sticky owner is lost mid-stream."""
+        if self._done:
+            raise RuntimeError(
+                f"stream {self.stream_id!r} already finished"
+            )
+        ops = encode_history(chunk)
+        try:
+            out = self.client._roundtrip(
+                "POST", "/check/stream",
+                self._payload(ops, final),
+            )
+        except (ServiceError, OSError) as e:
+            retriable = (
+                isinstance(e, OSError)
+                or e.status in (500, 503)
+            )
+            if not (retriable and self._sent):
+                raise
+            # member-death-shaped failure mid-stream: re-resolve the
+            # owner through the door and replay from op 0
+            out = self._replay(ops, final)
+        else:
+            m = out.get("fleet_member")
+            if self._member is None:
+                self._member = m
+            elif m != self._member:
+                # the sticky owner died and the door failed this
+                # chunk over: it landed COLD on the successor —
+                # discard that answer and re-prime the new owner
+                # with the whole stream
+                out = self._replay(ops, final)
+        self._sent.append(ops)
+        if final:
+            self._done = True
+        return out
+
+    def finish(self, chunk=()) -> dict:
+        """Final append: returns the definite verdict."""
+        return self.append(chunk, final=True)
+
+    def _replay(self, ops: list, final: bool) -> dict:
+        last_err: Optional[Exception] = None
+        for _ in range(MAX_STREAM_REPLAYS):
+            self.replays += 1
+            try:
+                out, members = self._replay_pass(ops, final)
+            except (ServiceError, OSError) as e:
+                last_err = e
+                continue
+            if len(members) > 1:
+                # a member died DURING the replay: head and tail
+                # landed on different owners — replay again
+                continue
+            self._member = members.pop() if members else None
+            return out
+        if last_err is not None:
+            raise last_err
+        raise ServiceError(
+            503, "stream-replay-failed",
+            {"detail": "fleet membership would not hold still"},
+        )
+
+    def _replay_pass(self, ops: list, final: bool) -> tuple:
+        """One full replay: every buffered chunk then the current
+        one, restart=true on the first so the new owner drops any
+        poisoned partial stream before rebuilding. Returns (last
+        response, set of serving member ids)."""
+        chunks = list(self._sent) + [ops]
+        members: set = set()
+        out: dict = {}
+        for i, chunk in enumerate(chunks):
+            is_last = i == len(chunks) - 1
+            out = self.client._roundtrip(
+                "POST", "/check/stream",
+                self._payload(
+                    chunk, final and is_last, restart=(i == 0)
+                ),
+            )
+            m = out.get("fleet_member")
+            if m is not None:
+                members.add(m)
+        return out, members

@@ -35,6 +35,19 @@ on its owner, so /check/stream follows the ring and fails over only
 on owner death — a durable stream replayed from the start resumes
 from its persisted frontier on the new owner, same as solo restarts.
 
+Gray failures get their own ladder, distinct from death: a forward
+that TIMES OUT (connection accepted, reply never came — SIGSTOP, GC
+stall, asymmetric partition) marks the member SUSPECT and hedges the
+same bytes onto the ring successor without declaring death; only
+refused/reset (nothing listening) takes the ``note_member_death``
+quarantine path. Every forward feeds a per-member latency EWMA +
+error-rate EWMA, and a member whose error rate stays above the
+threshold is proactively DRAINED from routing for a cooldown, then
+re-probed — slow-but-alive members leave the hot path within
+~2× the health window instead of poisoning every request that hashes
+to them (the dominant production failure class per the gray-failure
+literature, PAPERS.md).
+
 The door itself keeps NO tenant state: everything it knows is
 re-derivable from the fleet dir + quarantine ledger, so the door is
 restartable and (because intents are durable) its death mid-flight
@@ -48,12 +61,14 @@ import http.client
 import json
 import logging
 import os
+import socket
 import threading
 import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
+from jepsen_tpu.obs import trace as obs_trace
 from jepsen_tpu.service.membership import FleetRegistry, MemberInfo
 
 log = logging.getLogger("jepsen_tpu.service.fleet")
@@ -69,6 +84,17 @@ RETRY_AFTER_S = 1
 #: time in proxy mode (durable checks can run many segments)
 DEFAULT_FORWARD_TIMEOUT_S = 120.0
 
+#: gray-failure health defaults: a member whose error-rate EWMA sits
+#: at/above the threshold after at least MIN_SAMPLES observations is
+#: proactively drained from routing for a cooldown (2× the window by
+#: default), then re-probed.
+DEFAULT_HEALTH_WINDOW_S = 30.0
+DEFAULT_DEGRADE_ERR_RATE = 0.5
+DEFAULT_DEGRADE_MIN_SAMPLES = 3
+
+#: error-rate / latency EWMA smoothing per observation
+_HEALTH_ALPHA = 0.4
+
 
 def _fleet_counters() -> dict:
     return {
@@ -78,6 +104,9 @@ def _fleet_counters() -> dict:
         "steals": 0,        # shed by owner, accepted by a successor
         "handoffs": 0,      # owner died mid-flight, replayed onward
         "member_deaths": 0, # deaths this door declared
+        "suspects": 0,      # timeouts treated as gray, NOT death
+        "hedges": 0,        # suspect retried on a ring successor
+        "degraded_evictions": 0,  # proactive drains of gray members
         "exhausted": 0,     # every alive member shed or died
         "intents_recovered": 0,
     }
@@ -96,6 +125,10 @@ class FleetFrontDoor:
         mode: str = "proxy",
         forward_timeout_s: float = DEFAULT_FORWARD_TIMEOUT_S,
         ttl_s: Optional[float] = None,
+        health_window_s: float = DEFAULT_HEALTH_WINDOW_S,
+        degrade_err_rate: float = DEFAULT_DEGRADE_ERR_RATE,
+        degrade_min_samples: int = DEFAULT_DEGRADE_MIN_SAMPLES,
+        degrade_cooldown_s: Optional[float] = None,
     ):
         if mode not in ("proxy", "redirect"):
             raise ValueError(f"unknown front-door mode: {mode!r}")
@@ -107,6 +140,21 @@ class FleetFrontDoor:
         os.makedirs(self.intent_dir, exist_ok=True)
         self._stats_lock = threading.Lock()
         self._counters = _fleet_counters()
+        #: gray-failure health plane: per-member latency EWMA +
+        #: error-rate EWMA, guarded by _health_lock. A member whose
+        #: error rate stays at/above ``degrade_err_rate`` is drained
+        #: from routing (``_degraded``: member_id -> evicted-at) for
+        #: ``degrade_cooldown_s``, then re-probed.
+        self.health_window_s = float(health_window_s)
+        self.degrade_err_rate = float(degrade_err_rate)
+        self.degrade_min_samples = int(degrade_min_samples)
+        self.degrade_cooldown_s = float(
+            2.0 * health_window_s
+            if degrade_cooldown_s is None else degrade_cooldown_s
+        )
+        self._health_lock = threading.Lock()
+        self._health: Dict[int, dict] = {}
+        self._degraded: Dict[int, float] = {}
         self.started_at = time.time()
         handler = type(
             "FleetHandler", (_FleetHandler,), {"door": self}
@@ -214,6 +262,87 @@ class FleetFrontDoor:
             out.append((status, obj))
         return out
 
+    # -- gray-failure health -------------------------------------------
+
+    def note_member_latency(
+        self, member_id: int, elapsed_s: float, ok: bool
+    ) -> None:
+        """Feed one forward's outcome into the member's health score.
+        Timeouts feed ``ok=False`` with the full timeout as latency —
+        the EWMA pair is exactly what distinguishes slow-but-alive
+        (gray) from healthy. Crossing the degradation threshold drains
+        the member from routing (eviction instant fired OUTSIDE the
+        health lock)."""
+        mid = int(member_id)
+        evicted = False
+        with self._health_lock:
+            row = self._health.setdefault(mid, {
+                "ewma_ms": None, "err_rate": 0.0, "samples": 0,
+            })
+            ms = elapsed_s * 1000.0
+            row["ewma_ms"] = (
+                ms if row["ewma_ms"] is None
+                else (1 - _HEALTH_ALPHA) * row["ewma_ms"]
+                + _HEALTH_ALPHA * ms
+            )
+            row["err_rate"] = (
+                (1 - _HEALTH_ALPHA) * row["err_rate"]
+                + _HEALTH_ALPHA * (0.0 if ok else 1.0)
+            )
+            row["samples"] += 1
+            row["last_ts"] = time.time()
+            if (
+                mid not in self._degraded
+                and row["samples"] >= self.degrade_min_samples
+                and row["err_rate"] >= self.degrade_err_rate
+            ):
+                self._degraded[mid] = time.monotonic()
+                evicted = True
+        if evicted:
+            self._bump("degraded_evictions")
+            log.warning(
+                "member %d persistently degraded (gray); draining "
+                "from routing for %.1fs", mid, self.degrade_cooldown_s,
+            )
+            obs_trace.instant(
+                "member_degraded", kind="fleet", member=mid,
+            )
+
+    def _routable(
+        self, order: List[MemberInfo]
+    ) -> List[MemberInfo]:
+        """Drop degraded-drained members from a route order; expired
+        cooldowns are re-admitted on probation (health row reset, so
+        stale error history cannot instantly re-evict a recovered
+        member). Falls back to the full order rather than routing
+        nowhere when EVERY member is drained."""
+        now = time.monotonic()
+        with self._health_lock:
+            for mid, t in list(self._degraded.items()):
+                if now - t >= self.degrade_cooldown_s:
+                    del self._degraded[mid]
+                    self._health.pop(mid, None)
+            drained = set(self._degraded)
+        if not drained:
+            return order
+        kept = [m for m in order if m.member_id not in drained]
+        return kept or order
+
+    def health_snapshot(self) -> dict:
+        """Per-member health rows + the currently-drained set (the
+        invariant monitor's gray-eviction evidence)."""
+        with self._health_lock:
+            return {
+                "window_s": self.health_window_s,
+                "err_threshold": self.degrade_err_rate,
+                "cooldown_s": self.degrade_cooldown_s,
+                "rows": {
+                    str(mid): dict(row)
+                    for mid, row in self._health.items()
+                },
+                "degraded": sorted(self._degraded),
+            }
+
     # -- forwarding ----------------------------------------------------
 
     def _forward(
@@ -270,7 +399,7 @@ class FleetFrontDoor:
         (path /check/stream) are sticky: owner or fail-over only,
         never stolen — their incremental state is member-local."""
         self._bump("routed")
-        order = self.registry.route_order(tenant)
+        order = self._routable(self.registry.route_order(tenant))
         if not order:
             return 503, {
                 "error": "fleet-empty",
@@ -282,16 +411,41 @@ class FleetFrontDoor:
             intent = self.journal_intent(tenant, path, body)
         shed_status, shed_obj = None, None
         for i, member in enumerate(order):
+            t0 = time.monotonic()
             try:
                 status, obj = self._forward(
                     member, tenant, path, body
                 )
+            except (socket.timeout, TimeoutError):
+                # SUSPECT, not dead: the member accepted the
+                # connection but never answered inside the forward
+                # budget — the gray-failure signature (SIGSTOP, GC
+                # stall, asymmetric partition). Declaring death here
+                # is the classic mistake (a slow member quarantined
+                # fleet-wide on one slow reply); instead the health
+                # EWMA takes the strike — persistent grayness drains
+                # the member — and the SAME bytes hedge onto the ring
+                # successor, safe because check_id_for content-hash
+                # identity makes the duplicate submission idempotent
+                # (same checkpoint file, convergent verdict).
+                log.warning(
+                    "member %d timed out (suspect); hedging onward",
+                    member.member_id,
+                )
+                self.note_member_latency(
+                    member.member_id,
+                    time.monotonic() - t0, ok=False,
+                )
+                self._bump("suspects")
+                if i + 1 < len(order):
+                    self._bump("hedges")
+                continue
             except OSError:
-                # The owner (or a successor) died on the wire: one
-                # declaration ejects it fleet-wide, and the SAME
-                # bytes move to the next ring member — content-hash
-                # identity turns this into a checkpoint resume for
-                # durable checks.
+                # Refused/reset: the owner (or a successor) is DEAD
+                # on the wire — nothing is listening. One declaration
+                # ejects it fleet-wide, and the SAME bytes move to
+                # the next ring member — content-hash identity turns
+                # this into a checkpoint resume for durable checks.
                 log.warning(
                     "member %d dead on the wire; handing off",
                     member.member_id,
@@ -301,6 +455,9 @@ class FleetFrontDoor:
                 if i + 1 < len(order):
                     self._bump("handoffs")
                 continue
+            self.note_member_latency(
+                member.member_id, time.monotonic() - t0, ok=True,
+            )
             if status in SHED and not sticky:
                 # Member-local admission is authoritative: the owner
                 # shed, so the check is queued-but-unstarted there.
@@ -380,6 +537,7 @@ class FleetFrontDoor:
             "members": members,
             "rollup": rollup,
             "membership": self.registry.snapshot(),
+            "health": self.health_snapshot(),
         }
 
 

@@ -69,6 +69,15 @@ def member_label(member_id: int) -> str:
     return f"{chaos.HOST_PREFIX}{int(member_id)}"
 
 
+class MemberFenced(RuntimeError):
+    """This member's identity has been superseded: its member file
+    carries a HIGHER epoch than its own (the supervisor respawned a
+    replacement while this incarnation was presumed dead). A fenced
+    member must stop announcing and drain — its in-flight checks were
+    already handed off by content identity, and re-claiming ownership
+    would double-own them."""
+
+
 @dataclass(frozen=True)
 class MemberInfo:
     """One member's announced identity, as read from its file."""
@@ -79,6 +88,10 @@ class MemberInfo:
     started_at: float
     heartbeat_ts: float
     draining: bool = False
+    #: supervision epoch: bumped by every supervisor respawn. The
+    #: journal fence — an older incarnation (lower epoch) may never
+    #: overwrite the row of the member that replaced it.
+    epoch: int = 0
 
     def to_json(self) -> dict:
         return {
@@ -89,6 +102,7 @@ class MemberInfo:
             "started_at": self.started_at,
             "heartbeat_ts": self.heartbeat_ts,
             "draining": self.draining,
+            "epoch": self.epoch,
         }
 
 
@@ -166,11 +180,13 @@ class FleetRegistry:
         member_id: Optional[int] = None,
         url: Optional[str] = None,
         ttl_s: float = DEFAULT_TTL_S,
+        epoch: int = 0,
     ):
         self.fleet_dir = fleet_dir
         self.member_id = member_id
         self.url = url
         self.ttl_s = float(ttl_s)
+        self.epoch = int(epoch)
         os.makedirs(fleet_dir, exist_ok=True)
         self._membership_lock = threading.Lock()
         #: routing cache, guarded by _membership_lock (JT206):
@@ -190,12 +206,35 @@ class FleetRegistry:
             self.fleet_dir, MEMBER_FILE_FMT.format(self.member_id)
         )
 
+    def _filed_epoch(self) -> Optional[int]:
+        """The epoch currently on disk for this member id, or None
+        when the file is missing/torn."""
+        try:
+            with open(self._my_path(), encoding="utf-8") as f:
+                d = json.load(f)
+            return int(d.get("epoch", 0))
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
     def announce(self, draining: bool = False) -> MemberInfo:
         """Durably publish this member's identity + a fresh
         heartbeat. Atomic (tmp+rename via the store primitive), so a
-        reader never sees a torn member file."""
+        reader never sees a torn member file.
+
+        The journal fence rides every announce: if the file on disk
+        already carries a HIGHER epoch, a supervisor respawned a
+        replacement while this incarnation was stalled or presumed
+        dead — raise ``MemberFenced`` instead of overwriting, so a
+        resurrected zombie can never reclaim the member row (or the
+        tenant ownership that goes with it)."""
         from jepsen_tpu.store import atomic_write_text
 
+        filed = self._filed_epoch()
+        if filed is not None and filed > self.epoch:
+            raise MemberFenced(
+                f"member {self.member_id} epoch {self.epoch} "
+                f"superseded by epoch {filed}"
+            )
         info = MemberInfo(
             member_id=int(self.member_id),
             url=str(self.url),
@@ -203,6 +242,7 @@ class FleetRegistry:
             started_at=self._started_at,
             heartbeat_ts=time.time(),
             draining=bool(draining),
+            epoch=self.epoch,
         )
         atomic_write_text(
             self._my_path(), json.dumps(info.to_json())
@@ -212,9 +252,13 @@ class FleetRegistry:
     heartbeat = announce
 
     def start_heartbeat(
-        self, interval_s: float = DEFAULT_HEARTBEAT_S
+        self,
+        interval_s: float = DEFAULT_HEARTBEAT_S,
+        on_fenced=None,
     ) -> None:
-        """Heartbeat on a daemon thread until ``stop_heartbeat``."""
+        """Heartbeat on a daemon thread until ``stop_heartbeat``.
+        ``on_fenced`` fires (once, from the heartbeat thread) when an
+        announce raises ``MemberFenced`` — the member should drain."""
         if self._hb_thread is not None:
             return
         self._hb_stop.clear()
@@ -223,6 +267,13 @@ class FleetRegistry:
             while not self._hb_stop.wait(interval_s):
                 try:
                     self.announce()
+                except MemberFenced:
+                    if on_fenced is not None:
+                        try:
+                            on_fenced()
+                        except Exception:  # noqa: BLE001
+                            pass
+                    return
                 except OSError:
                     pass  # fleet dir went away: the TTL judges us
 
@@ -244,8 +295,12 @@ class FleetRegistry:
         """Graceful leave: stop heartbeating and delete the member
         file, so routers drop this member on their next ring rebuild
         without waiting out the TTL (and without a quarantine row —
-        retirement is not death)."""
+        retirement is not death). Fenced incarnations must NOT unlink:
+        the file now belongs to the higher-epoch replacement."""
         self.stop_heartbeat()
+        filed = self._filed_epoch()
+        if filed is not None and filed > self.epoch:
+            return
         try:
             os.unlink(self._my_path())
         except OSError:
@@ -281,6 +336,7 @@ class FleetRegistry:
                     started_at=float(d.get("started_at", 0.0)),
                     heartbeat_ts=float(d["heartbeat_ts"]),
                     draining=bool(d.get("draining")),
+                    epoch=int(d.get("epoch", 0)),
                 ))
             except (OSError, ValueError, KeyError, TypeError):
                 continue  # torn/foreign file: not a member

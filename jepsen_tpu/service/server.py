@@ -169,6 +169,7 @@ class CheckerDaemon:
         audit_max_bytes: int = 4 * 1024 * 1024,
         fleet_dir: Optional[str] = None,
         member_id: Optional[int] = None,
+        member_epoch: Optional[int] = None,
         own_plane: bool = True,
     ):
         if interpret is None:
@@ -201,12 +202,28 @@ class CheckerDaemon:
         #: checkpoint state so a hand-off resume is attributable
         if fleet_dir is not None and member_id is None:
             member_id = 0
+        if member_epoch is None:
+            member_epoch = int(
+                os.environ.get("JEPSEN_TPU_FLEET_EPOCH", "0") or 0
+            )
         self.member_id = member_id
+        self.member_epoch = int(member_epoch)
         self.fleet_dir = fleet_dir
         self._registry = None
-        owner = (
-            f"member-{member_id}" if member_id is not None else None
-        )
+        #: nemesis reply gate (service/nemesis.py ResponseGate): when
+        #: set, every response passes through it — the in-process
+        #: fleet's stall/delay/drop fault seam. None in production.
+        self.chaos_gate = None
+        # epoch 0 keeps the historical owner tag; a supervised
+        # respawn's owner carries its epoch so a hand-off BACK to a
+        # resurrected member id still reads as a distinct owner in
+        # checkpoint attribution
+        owner = None
+        if member_id is not None:
+            owner = (
+                f"member-{member_id}" if not self.member_epoch
+                else f"member-{member_id}e{self.member_epoch}"
+            )
         if own_plane:
             # Own the process-wide plane: mesh + memo + compile caches
             # live for the daemon's life; every tenant's checks share
@@ -246,10 +263,11 @@ class CheckerDaemon:
             from jepsen_tpu.service.membership import FleetRegistry
 
             self._registry = FleetRegistry(
-                fleet_dir, member_id=member_id, url=self.url
+                fleet_dir, member_id=member_id, url=self.url,
+                epoch=self.member_epoch,
             )
             self._registry.announce()
-            self._registry.start_heartbeat()
+            self._registry.start_heartbeat(on_fenced=self._on_fenced)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -261,6 +279,24 @@ class CheckerDaemon:
         log.info("checker daemon serving on %s (store=%s)",
                  self.url, self.root)
         self.httpd.serve_forever(poll_interval=0.1)
+
+    def _on_fenced(self) -> None:
+        """The heartbeat found a HIGHER epoch in this member's own
+        registry row: a supervisor respawned a replacement while this
+        incarnation was stalled/presumed dead. Re-claiming ownership
+        would double-own checks already handed off, so the only
+        correct move is to drain — stop admitting, finish what is in
+        flight (durable frontiers are safe either way), get off the
+        port."""
+        log.warning(
+            "member %s (epoch %d) fenced by a newer incarnation; "
+            "draining", self.member_id, self.member_epoch,
+        )
+        obs_trace.instant(
+            "member_fenced", kind="fleet",
+            member=self.member_id, epoch=self.member_epoch,
+        )
+        self.drain()
 
     def drain(self, signum: Optional[int] = None) -> bool:
         """Graceful drain: stop admitting, wait (bounded) for
@@ -275,10 +311,14 @@ class CheckerDaemon:
             f" (signal {signum})" if signum else "", self.drain_s,
         )
         if self._registry is not None:
-            # Routers skip draining members immediately (no TTL wait)
+            # Routers skip draining members immediately (no TTL wait).
+            # A FENCED member must not touch the row at all — it
+            # belongs to the replacement now (announce would raise).
+            from jepsen_tpu.service.membership import MemberFenced
+
             try:
                 self._registry.announce(draining=True)
-            except OSError:
+            except (OSError, MemberFenced):
                 pass
         self.admission.start_drain()
         clean = self.admission.wait_idle(self.drain_s)
@@ -328,6 +368,7 @@ class CheckerDaemon:
             # and the fleet bench key their per-member rows on this
             out["member"] = {
                 "member_id": self.member_id,
+                "epoch": self.member_epoch,
                 "fleet_dir": self.fleet_dir,
                 "url": self.url,
                 "pid": os.getpid(),
@@ -492,6 +533,7 @@ class CheckerDaemon:
                 raise ValueError("stream_id is required")
             ops = [op_from_json(d) for d in req.get("ops", [])]
             final = bool(req.get("final"))
+            restart = bool(req.get("restart"))
             deadline_s = req.get("deadline_s")
             if deadline_s is not None:
                 deadline_s = float(deadline_s)
@@ -499,6 +541,16 @@ class CheckerDaemon:
             return 400, {"error": "bad-request", "detail": str(e)}
         key = (tenant, stream_id)
         with self._streams_lock:
+            if restart:
+                # The client is replaying the stream from op 0 (fleet
+                # fail-over: the sticky owner died and a mid-stream
+                # chunk may have landed here cold). Drop any existing
+                # handle so the replay builds a coherent history
+                # instead of appending after a poisoned prefix; a
+                # DURABLE stream still resumes launch-free from its
+                # persisted frontier when the replayed prefix hashes
+                # identically.
+                self._streams.pop(key, None)
             ent = self._streams.get(key)
             if ent is None:
                 path = None
@@ -594,7 +646,24 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):  # quiet
         pass
 
+    def _gate_allows_reply(self) -> bool:
+        """The nemesis reply gate (service/nemesis.py): requests are
+        ACCEPTED and processed normally — only the reply is delayed,
+        stalled, or dropped. That asymmetry is the point: a gray
+        member looks alive at the TCP layer while starving its
+        callers, which is exactly what the front door's suspect
+        ladder must detect."""
+        g = getattr(self.daemon_obj, "chaos_gate", None)
+        if g is None:
+            return True
+        if g.apply() == "drop":
+            self.close_connection = True
+            return False
+        return True
+
     def _send_json(self, code: int, obj: dict) -> None:
+        if not self._gate_allows_reply():
+            return
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -607,6 +676,8 @@ class _Handler(BaseHTTPRequestHandler):
         return t or DEFAULT_TENANT
 
     def _send_text(self, code: int, body: bytes, ctype: str) -> None:
+        if not self._gate_allows_reply():
+            return
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))

@@ -198,6 +198,121 @@ def test_member_death_quarantines_and_reroutes(tmp_path):
     assert snap["ring_members"] == [0]
 
 
+def test_heartbeat_exactly_at_ttl_boundary_is_alive(
+    tmp_path, monkeypatch
+):
+    """The TTL gate is inclusive: ``now - heartbeat_ts == ttl_s``
+    EXACTLY is still alive (the member's next heartbeat is due this
+    instant, not overdue); one epsilon past is dead. Pin the clock so
+    the assertion exercises the comparison, not test latency."""
+    import jepsen_tpu.service.membership as membership
+
+    fdir = str(tmp_path / "fleet")
+    me = FleetRegistry(
+        fdir, member_id=0, url="http://127.0.0.1:1", ttl_s=10.0
+    )
+    me.announce()
+    router = FleetRegistry(fdir, ttl_s=10.0)
+    hb = router.member_by_id(0).heartbeat_ts
+    monkeypatch.setattr(membership.time, "time", lambda: hb + 10.0)
+    assert [m.member_id for m in router.alive_members()] == [0]
+    assert router.ring().member_ids == (0,)
+    monkeypatch.setattr(
+        membership.time, "time", lambda: hb + 10.0 + 1e-3
+    )
+    assert router.alive_members() == []
+    assert router.ring().member_ids == ()
+
+
+def test_torn_heartbeat_row_racing_alive_members(tmp_path):
+    """A torn member row landing mid-read (the nemesis torn_write
+    fault): readers skip the member — never crash, never route on
+    garbage — and the member's own next heartbeat heals the row."""
+    from jepsen_tpu.service.nemesis import torn_member_write
+
+    fdir = str(tmp_path / "fleet")
+    a = FleetRegistry(fdir, member_id=0, url="http://127.0.0.1:1")
+    b = FleetRegistry(fdir, member_id=1, url="http://127.0.0.1:2")
+    a.announce()
+    b.announce()
+    router = FleetRegistry(fdir)
+    assert router.ring().member_ids == (0, 1)
+
+    # a reader hammering alive_members() while the row tears and
+    # heals: every observed set is a subset of the true membership
+    stop = threading.Event()
+    observed, errors = [], []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                ids = frozenset(
+                    m.member_id for m in router.alive_members()
+                )
+                router.ring()  # the cached-ring rebuild path too
+            except Exception as e:  # noqa: BLE001 - the regression
+                errors.append(repr(e))
+                return
+            observed.append(ids)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    try:
+        for _ in range(25):
+            torn_member_write(fdir, 1)
+            b.heartbeat()  # atomic rewrite heals the row
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert errors == []
+    assert observed and all(ids <= {0, 1} for ids in observed)
+
+    # steady-state torn (no heal yet): the member is simply absent
+    torn_member_write(fdir, 1)
+    assert [m.member_id for m in router.alive_members()] == [0]
+    assert router.ring().member_ids == (0,)
+    b.heartbeat()
+    assert {m.member_id for m in router.alive_members()} == {0, 1}
+
+
+def test_retire_racing_note_member_death_converges(tmp_path):
+    """``retire()`` and ``note_member_death()`` racing over the same
+    member: both interleavings converge on one ring state (member
+    gone), both calls are idempotent, and scoped re-admission
+    restores exactly the cleared member."""
+    fdir = str(tmp_path / "fleet")
+    regs = {}
+    for i in (0, 1):
+        regs[i] = FleetRegistry(
+            fdir, member_id=i, url=f"http://127.0.0.1:{7100 + i}"
+        )
+        regs[i].announce()
+    router = FleetRegistry(fdir)
+    assert router.ring().member_ids == (0, 1)
+
+    # interleaving 1: death note first, then the retire lands
+    router.note_member_death(1)
+    regs[1].retire()
+    router.note_member_death(1)  # idempotent re-declare
+    regs[1].retire()             # idempotent re-retire
+    assert router.ring().member_ids == (0,)
+    assert router.member_by_id(1) is None
+
+    # interleaving 2: retire first, then a late death note
+    regs[0].retire()
+    router.note_member_death(0)
+    assert router.ring().member_ids == ()
+    assert router.alive_members() == []
+
+    # convergence is recoverable: clear the scoped quarantine labels
+    # and re-announce — the full fleet routes again
+    chaos.clear_quarantine_label(member_label(0))
+    chaos.clear_quarantine_label(member_label(1))
+    regs[0].announce()
+    regs[1].announce()
+    assert router.ring().member_ids == (0, 1)
+
+
 # -- the in-process fleet ---------------------------------------------
 #
 # Two daemons in ONE process share the default dispatch plane
@@ -207,9 +322,10 @@ def test_member_death_quarantines_and_reroutes(tmp_path):
 
 
 class _Fleet:
-    def __init__(self, tmp_path, n=2, mode="proxy", **daemon_kw):
+    def __init__(self, tmp_path, n=2, mode="proxy", door_kw=None,
+                 **daemon_kw):
         self.fdir = str(tmp_path / "fleet")
-        root = str(tmp_path / "store")
+        self.root = root = str(tmp_path / "store")
         self.daemons = []
         self.threads = []
         for i in range(n):
@@ -224,7 +340,9 @@ class _Fleet:
             t.start()
             self.daemons.append(d)
             self.threads.append(t)
-        self.door = FleetFrontDoor(self.fdir, port=0, mode=mode)
+        self.door = FleetFrontDoor(
+            self.fdir, port=0, mode=mode, **(door_kw or {})
+        )
         self.door_thread = threading.Thread(
             target=self.door.serve_forever, daemon=True
         )

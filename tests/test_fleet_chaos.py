@@ -1,0 +1,403 @@
+"""Fleet-nemesis tests (service/nemesis.py, service/supervisor.py,
+service/invariants.py + the gray-failure plane in frontdoor.py).
+
+The contract under test, per PR 19 surface:
+
+- gray failure != death: a SIGSTOPped (stalled) member accepts
+  connections and never replies; the door must SUSPECT it (hedge the
+  same bytes to the ring successor, feed the health EWMA) and never
+  quarantine it — persistent grayness drains it from routing for a
+  cooldown instead, and it is re-admitted on probation afterward.
+- stream stickiness survives the sticky owner dying mid-stream: the
+  ClientStream replays its buffered chunks at the new owner with
+  restart=true, and the final verdict matches a solo oracle.
+- supervision epoch fencing: once a replacement announces with a
+  higher epoch, the old incarnation's announce raises MemberFenced,
+  its retire() refuses to unlink the replacement's row, and its
+  heartbeat thread drains through on_fenced.
+- quarantine re-admission is scoped: clear_quarantine_label amnesties
+  exactly one label, never the whole breaker ledger.
+- the drill invariants hold end-to-end in-process: kill + torn-write
+  chaos under live traffic, supervisor respawn with a bumped epoch,
+  zero accepted-check loss, at-most-once verdict effects, verdict
+  parity vs a solo oracle — report["clean"] is the same gate
+  `cli fleet-drill` exits 8 on.
+
+Everything here is in-process and tier-1 (Pallas interpret mode); the
+subprocess gauntlet (real SIGSTOP/SIGKILL, cli fleet-drill) lives in
+tools/drill-smoke.sh.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu.checker import chaos, dispatch
+from jepsen_tpu.checker.linearizable import LinearizableChecker
+from jepsen_tpu.history.history import History
+from jepsen_tpu.service.client import encode_history
+from jepsen_tpu.service.invariants import InvariantMonitor
+from jepsen_tpu.service.membership import (
+    FleetRegistry,
+    MemberFenced,
+    member_label,
+)
+from jepsen_tpu.service.nemesis import (
+    FleetChaosPlan,
+    FleetFault,
+    FleetNemesis,
+    LocalMemberHandle,
+)
+from jepsen_tpu.service.server import CheckerDaemon, check_id_for
+from jepsen_tpu.service.supervisor import (
+    FleetSupervisor,
+    SupervisionPolicy,
+)
+from jepsen_tpu.store import op_from_json
+from test_fleet import _Fleet, _fstrip, _tenant_owned_by
+from test_service import _client, _register, _strip
+
+pytestmark = [pytest.mark.fleet, pytest.mark.fleet_chaos]
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    """Chaos tests quarantine members and swap planes; never leak
+    either into the next test."""
+    yield
+    chaos.reset_resilience()
+    dispatch.reset_default_plane()
+
+
+# -- gray failure: suspect, hedge, drain — never declare death --------
+
+
+def test_stalled_member_is_suspected_not_killed(tmp_path):
+    """THE timeout/refused distinction (satellite 1): a stalled
+    member (accepts connections, replies never come — the in-process
+    SIGSTOP analog) must ride the suspect/hedge ladder, not the death
+    ladder. Checks against its tenants still succeed via the ring
+    successor; the member is never quarantined; after three strikes
+    the health plane drains it, and after the cooldown it serves
+    again."""
+    fl = _Fleet(
+        tmp_path, n=2,
+        door_kw=dict(forward_timeout_s=0.75, health_window_s=1.0),
+    )
+    try:
+        ring = fl.door.registry.ring()
+        victim, survivor = 0, 1
+        handle = LocalMemberHandle(victim, fl.daemons[victim])
+        good = _register(1901, n_ops=40)
+        local = LinearizableChecker(interpret=True).check({}, good)
+        # warm the daemon pipeline (compile cache + dispatch plane)
+        # so a healthy member answers well inside the 0.75s forward
+        # budget — the budget must separate gray from healthy, not
+        # from cold
+        _client(fl.daemons[survivor], tenant="warm", timeout_s=60
+                ).check(good, model="cas-register")
+
+        handle.stall()
+        for k in range(3):
+            t = _tenant_owned_by(ring, victim, prefix=f"gray{k}")
+            out = fl.client(t, timeout_s=30).check(
+                good, model="cas-register"
+            )
+            # hedged onto the survivor, same verdict as a solo run
+            assert out["fleet_member"] == survivor
+            assert _fstrip(out) == _strip(local)
+
+        # suspect, NOT dead: no quarantine row, no death counter
+        assert not chaos.is_quarantined(member_label(victim))
+        c = fl.door._counters
+        assert c.get("member_deaths", 0) == 0
+        assert c.get("suspects", 0) >= 3
+        assert c.get("hedges", 0) >= 3
+        # three strikes at err_rate >= 0.5: drained from routing
+        assert victim in fl.door.health_snapshot()["degraded"]
+
+        # a drained member is skipped WITHOUT paying the timeout
+        suspects_before = c.get("suspects", 0)
+        t = _tenant_owned_by(ring, victim, prefix="drained")
+        out = fl.client(t, timeout_s=30).check(
+            good, model="cas-register"
+        )
+        assert out["fleet_member"] == survivor
+        assert fl.door._counters.get("suspects", 0) == suspects_before
+
+        # recovery: unstall + cooldown (2x window) -> probation
+        handle.unstall()
+        time.sleep(fl.door.degrade_cooldown_s + 0.3)
+        t = _tenant_owned_by(ring, victim, prefix="healed")
+        out = fl.client(t, timeout_s=30).check(
+            good, model="cas-register"
+        )
+        assert out["fleet_member"] == victim
+        assert victim not in fl.door.health_snapshot()["degraded"]
+    finally:
+        handle.open()
+        fl.close()
+
+
+# -- sticky streams survive the sticky owner dying --------------------
+
+
+def test_stream_survives_sticky_owner_death(tmp_path):
+    """Satellite 2: kill the stream's sticky owner after the first
+    chunk; the next append fails over, the ClientStream replays the
+    buffered prefix at the new owner, and the final verdict matches
+    the solo oracle."""
+    fl = _Fleet(tmp_path, n=2)
+    try:
+        ring = fl.door.registry.ring()
+        victim, survivor = 0, 1
+        tenant = _tenant_owned_by(ring, victim, prefix="stream")
+        good = _register(1902, n_ops=45)
+        local = LinearizableChecker(interpret=True).check({}, good)
+        ops = list(good)
+        sc = fl.client(tenant, timeout_s=30).stream(
+            "s-chaos-1", model="cas-register"
+        )
+        out = sc.append(ops[:15])
+        assert out["fleet_member"] == victim
+
+        LocalMemberHandle(victim, fl.daemons[victim]).kill()
+
+        out = sc.append(ops[15:30])
+        assert out["fleet_member"] == survivor
+        out = sc.finish(ops[30:])
+        assert out["fleet_member"] == survivor
+        assert sc.replays >= 1  # the buffered prefix was replayed
+        assert out["valid?"] == local["valid?"]
+        # the owner died on the wire: death ladder, not suspect ladder
+        assert chaos.is_quarantined(member_label(victim))
+        assert fl.door._counters.get("member_deaths", 0) >= 1
+    finally:
+        fl.close()
+
+
+# -- supervision epoch fencing ----------------------------------------
+
+
+def test_epoch_fencing_blocks_resurrected_incarnation(tmp_path):
+    """A respawned replacement (higher epoch) permanently fences the
+    old incarnation: announce raises, retire refuses to unlink the
+    replacement's row, and a running heartbeat drains via
+    on_fenced."""
+    fdir = str(tmp_path / "fleet")
+    old = FleetRegistry(
+        fdir, member_id=0, url="http://127.0.0.1:1", epoch=0
+    )
+    old.announce()
+    repl = FleetRegistry(
+        fdir, member_id=0, url="http://127.0.0.1:2", epoch=1
+    )
+    repl.announce()
+
+    with pytest.raises(MemberFenced):
+        old.announce()
+    # the old incarnation may not unlink the replacement's row
+    old.retire()
+    assert old._filed_epoch() == 1
+    assert [
+        (m.member_id, m.epoch, m.url)
+        for m in FleetRegistry(fdir).alive_members()
+    ] == [(0, 1, "http://127.0.0.1:2")]
+
+    # a heartbeating zombie drains through on_fenced instead of
+    # overwriting the replacement forever
+    fenced = threading.Event()
+    old.start_heartbeat(interval_s=0.05, on_fenced=fenced.set)
+    assert fenced.wait(5.0)
+    old.stop_heartbeat()
+    repl.retire()
+
+
+def test_clear_quarantine_label_is_scoped(tmp_path):
+    """Re-admission amnesties exactly one label: the respawned
+    member's host row — no other breaker is cleared."""
+    chaos.quarantine_label(member_label(0))
+    chaos.quarantine_label(member_label(1))
+    assert chaos.clear_quarantine_label(member_label(0)) is True
+    assert not chaos.is_quarantined(member_label(0))
+    assert chaos.is_quarantined(member_label(1))  # untouched
+    # idempotent: a second clear is a no-op
+    assert chaos.clear_quarantine_label(member_label(0)) is False
+
+
+# -- the in-process mini drill ----------------------------------------
+
+
+def _bodies(seed, n=3, n_ops=30):
+    """Prebuilt /check payloads with content identity, the drill
+    traffic pool shape (nemesis._drill_histories, in miniature)."""
+    rows = []
+    for k in range(n):
+        hist = _register(seed * 101 + k, n_ops=n_ops)
+        ops = encode_history(hist)
+        body = json.dumps(
+            {"history": ops, "model": "cas-register"}
+        ).encode()
+        rows.append({
+            "body": body, "ops": ops, "model": "cas-register",
+            "check_id": check_id_for("cas-register", body),
+        })
+    return rows
+
+
+def test_mini_drill_invariants_hold_with_respawn(tmp_path):
+    """The drill gate, in-process: kill one member and tear the
+    other's registry row while live traffic flows; the supervisor
+    respawns the dead member with a bumped epoch, the sweep resolves
+    every accepted check, and the invariant monitor's report — the
+    exact exit-8 gate `cli fleet-drill` enforces — comes back
+    clean."""
+    fl = _Fleet(tmp_path, n=2)
+    spawned = []  # (daemon, thread) respawned in-process
+    sup = nem = None
+    monitor = InvariantMonitor(target_members=2)
+    try:
+        victim, torn = 1, 0
+
+        def spawn_fn(mid, epoch):
+            d = CheckerDaemon(
+                root=fl.root, port=0, interpret=True,
+                fleet_dir=fl.fdir, member_id=mid,
+                member_epoch=epoch, own_plane=False,
+            )
+            t = threading.Thread(
+                target=d.serve_forever, daemon=True
+            )
+            t.start()
+            spawned.append((d, t))
+            return None
+
+        sup = FleetSupervisor(
+            fl.fdir, range(2), spawn_fn=spawn_fn,
+            policy=SupervisionPolicy(
+                restart_budget=3, backoff_base_s=0.1,
+                backoff_max_s=0.5, spawn_grace_s=15.0,
+                poll_interval_s=0.1, confirm_s=0.2,
+            ),
+        )
+        sup.start()
+        monitor.watch(door=fl.door, supervisor=sup, interval_s=0.1)
+
+        plan = FleetChaosPlan(faults=[
+            FleetFault("kill", victim, at_s=0.5),
+            FleetFault("torn_write", torn, at_s=0.9),
+        ], seed=5)
+        nem = FleetNemesis(
+            plan,
+            {i: LocalMemberHandle(i, fl.daemons[i])
+             for i in range(2)},
+            fleet_dir=fl.fdir, store_root=fl.root,
+            monitor=monitor,
+        )
+
+        ring = fl.door.registry.ring()
+        tenants = [
+            _tenant_owned_by(ring, 0, prefix="drill0"),
+            _tenant_owned_by(ring, 1, prefix="drill1"),
+        ]
+        pools = {
+            t: _bodies(1000 + i) for i, t in enumerate(tenants)
+        }
+        clients = {
+            t: fl.client(t, retries=3, backoff_s=0.05,
+                         timeout_s=30)
+            for t in tenants
+        }
+
+        nem.start()
+        from jepsen_tpu.service.client import ServiceError
+        deadline = time.monotonic() + 6.0
+        k = 0
+        while time.monotonic() < deadline and not (
+            nem.done() and k >= 2 * 2 * 3
+        ):
+            tenant = tenants[k % 2]
+            row = pools[tenant][(k // 2) % 3]
+            k += 1
+            monitor.note_submitted(
+                tenant, row["check_id"], row["model"],
+                row["ops"], None,
+            )
+            try:
+                out = clients[tenant]._roundtrip(
+                    "POST", "/check", row["body"]
+                )
+                monitor.note_verdict(tenant, row["check_id"], out)
+            except (ServiceError, OSError) as e:
+                monitor.note_client_error(
+                    tenant, row["check_id"], e
+                )
+            time.sleep(0.05)
+        nem.stop()
+
+        # settle: the supervisor must restore the fleet to size
+        restore_deadline = time.monotonic() + 20.0
+        while time.monotonic() < restore_deadline:
+            if len(fl.door.registry.alive_members()) >= 2:
+                break
+            time.sleep(0.2)
+
+        # final sweep: resubmit every unanswered accepted check
+        for req in monitor.pending_requests():
+            tenant, cid = req["tenant"], req["check_id"]
+            row = next(
+                r for r in pools[tenant] if r["check_id"] == cid
+            )
+            out = fl.client(
+                tenant, retries=5, backoff_s=0.2, timeout_s=60
+            )._roundtrip("POST", "/check", row["body"])
+            monitor.note_verdict(tenant, cid, out)
+        fl.door.recover_intents()
+        orphans = len([
+            n for n in os.listdir(fl.door.intent_dir)
+            if n.endswith(".json")
+        ])
+        monitor.stop()
+        sup.stop()
+
+        def oracle(model, ops, init_value):
+            hist = History(
+                [op_from_json(d) for d in ops], indexed=True
+            )
+            out = LinearizableChecker(
+                model=model, init_value=init_value,
+                interpret=True,
+            ).check({}, hist)
+            return bool(out.get("valid?"))
+
+        monitor.run_parity(oracle)
+        report = monitor.report(orphan_intents=orphans)
+        assert report["clean"], report["violations"]
+        assert report["checks"]["submissions"] >= 12
+        assert report["checks"]["lost"] == 0
+        assert report["parity"]["mismatches"] == []
+
+        # the kill was real and the heal was supervised: a bumped
+        # epoch, within budget
+        snap = sup.snapshot()
+        assert snap["respawns"][victim] >= 1
+        assert snap["respawns"][victim] <= 3
+        assert snap["epochs"][victim] >= 1
+        assert not snap["exhausted"]
+        fired = {f["kind"] for f in nem.fired}
+        assert fired == {"kill", "torn_write"}
+    finally:
+        if nem is not None:
+            nem.stop()
+        monitor.stop()
+        if sup is not None:
+            sup.stop()
+        for d, t in spawned:
+            d.admission.start_drain()
+            d.httpd.shutdown()
+            t.join(timeout=5)
+            d.close()
+        fl.close()

@@ -326,6 +326,40 @@ class Registry:
             self._ring = None
 """,
     ),
+    "JT207": (
+        # subprocess spawn while holding the registry lock: every
+        # router/supervisor thread contending for the lock stalls
+        # behind the fork/exec
+        """
+import subprocess
+import threading
+
+class Supervisor:
+    def __init__(self):
+        self._registry_lock = threading.Lock()
+        self.procs = {}
+
+    def respawn(self, mid):
+        with self._registry_lock:
+            self.procs[mid] = subprocess.Popen(["member", str(mid)])
+""",
+        # sanctioned shape: decide under the lock, release, then spawn
+        """
+import subprocess
+import threading
+
+class Supervisor:
+    def __init__(self):
+        self._registry_lock = threading.Lock()
+        self.procs = {}
+
+    def respawn(self, mid):
+        with self._registry_lock:
+            due = [mid]
+        for m in due:
+            self.procs[m] = subprocess.Popen(["member", str(m)])
+""",
+    ),
     "JT301": (
         # span held in a variable — never (reliably) closed
         """
@@ -645,7 +679,7 @@ def test_rule_catalog_partitions_by_family():
     all_rules = list(analysis.META_RULES) + family_rules
     assert len(all_rules) == len(set(all_rules))
     assert set(all_rules) == set(analysis.RULES)
-    assert analysis.rules_total() == len(analysis.RULES) == 26
+    assert analysis.rules_total() == len(analysis.RULES) == 27
 
 
 def test_host_get_funnel_itself_is_exempt():
@@ -1005,7 +1039,7 @@ def test_cli_json_contract():
     assert rec["clean"] is True
     assert rec["findings"] == []
     # per-rule descriptions and the catalog size ride the report
-    assert rec["rules_total"] == analysis.rules_total() == 26
+    assert rec["rules_total"] == analysis.rules_total() == 27
     assert set(rec["rules"]) == set(analysis.RULES)
     for meta in rec["rules"].values():
         assert meta["title"] and meta["invariant"]
